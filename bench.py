@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Benchmark driver entry point — prints ONE JSON line with the headline
+metric (decode throughput, reference harness schema: utils/benchmark.py
+throughput = generated tokens / wall time).
+
+Runs on whatever accelerator JAX sees (1 TPU chip under the driver).
+Model: Llama-3.2-1B-shaped decoder with synthetic bf16 weights (real 8B does
+not fit a single 16GB chip alongside its KV cache; shapes are real, weights
+random — throughput is weight-independent).
+
+vs_baseline = measured tok/s / HBM-bandwidth roofline tok/s for this chip
+(decode is bandwidth-bound: every step streams all params + KV once).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import numpy as np
+
+
+def main():
+    from neuronx_distributed_inference_tpu.config import (InferenceConfig,
+                                                          TpuConfig)
+    from neuronx_distributed_inference_tpu.models.application import \
+        CausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.parallel.mesh import (MeshConfig,
+                                                                 build_mesh)
+
+    batch = 2
+    prompt_len = 128
+    seq_len = 1024
+    chunk = 64
+
+    hf_attrs = dict(  # Llama-3.2-1B geometry
+        model_type="llama", hidden_size=2048, intermediate_size=8192,
+        num_hidden_layers=16, num_attention_heads=32, num_key_value_heads=8,
+        head_dim=64, vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
+        hidden_act="silu", tie_word_embeddings=True,
+    )
+    tcfg = TpuConfig(batch_size=batch, seq_len=seq_len,
+                     max_context_length=prompt_len, dtype="bfloat16",
+                     enable_bucketing=False, decode_chunk_tokens=chunk)
+    icfg = LlamaInferenceConfig(tcfg, **hf_attrs)
+    mesh = build_mesh(MeshConfig(tp=1))
+    app = CausalLMApplication(None, icfg, LlamaFamily, mesh=mesh)
+    app.init_random_weights(seed=0)
+    app.init_cache()
+
+    prompt = np.random.default_rng(0).integers(
+        0, 1000, size=(batch, prompt_len), dtype=np.int32)
+
+    # warmup / compile
+    t0 = time.perf_counter()
+    res = app.generate(prompt, max_new_tokens=chunk + 1)
+    compile_wall = time.perf_counter() - t0
+
+    # TTFT: prefill alone, post-compile
+    app.reset()
+    t0 = time.perf_counter()
+    out = app._run_prefill(prompt, np.full((batch,), prompt_len, np.int32))
+    jax.block_until_ready(out["tokens"])
+    ttft_ms = (time.perf_counter() - t0) * 1e3
+
+    # decode throughput: fused decode loop, several rounds
+    first = np.asarray(out["tokens"]).astype(np.int32)
+    positions = np.full((batch,), prompt_len, np.int32)
+    rounds, steps = 6, chunk
+    # one untimed round to settle
+    o = app._run_decode_loop(first, positions, steps)
+    jax.block_until_ready(o["tokens"])
+    positions = positions + steps
+    last = np.asarray(o["tokens"])[:, -1].astype(np.int32)
+    lat = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        o = app._run_decode_loop(last, positions, steps)
+        jax.block_until_ready(o["tokens"])
+        lat.append(time.perf_counter() - t0)
+        positions = positions + steps
+        last = np.asarray(o["tokens"])[:, -1].astype(np.int32)
+    total = sum(lat)
+    tok_s = batch * steps * rounds / total
+
+    # roofline: decode streams params + live KV once per step
+    param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(app.params))
+    kv_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(app.cache))
+    hbm_gbps = float(os.environ.get("NXDI_TPU_HBM_GBPS", "819"))  # v5e
+    roofline = hbm_gbps * 1e9 / (param_bytes + kv_bytes) * batch
+
+    print(json.dumps({
+        "metric": "decode_throughput_llama1b_bf16_bs2",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_s / roofline, 4),
+        "details": {
+            "ttft_ms_prompt128": round(ttft_ms, 2),
+            "per_step_latency_ms": round(total / (rounds * steps) * 1e3, 3),
+            "compile_plus_first_gen_s": round(compile_wall, 1),
+            "roofline_tok_s": round(roofline, 1),
+            "param_bytes": param_bytes,
+            "kv_bytes": kv_bytes,
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,483 @@
+"""Configuration system for the TPU-native inference framework.
+
+Mirrors the knob surface of the reference config system
+(reference: src/neuronx_distributed_inference/models/config.py:84-1042 —
+``NeuronConfig`` / ``InferenceConfig`` / sub-configs) but is designed TPU-first:
+parallelism degrees map onto named mesh axes (tp/cp/dp/ep) of a
+``jax.sharding.Mesh`` rather than process-group construction, and dtypes are
+JAX dtypes.
+
+Sub-config parity (reference: models/config.py):
+  - OnDeviceSamplingConfig      (:1064)
+  - ChunkedPrefillConfig        (:1078)
+  - MoEConfig / MoENeuronConfig (:798-846)
+  - FusedSpecConfig             (:1045)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+logger = logging.getLogger("nxdi_tpu")
+
+_DTYPE_MAP = {
+    "bfloat16": jnp.bfloat16,
+    "bf16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "fp32": jnp.float32,
+    "float16": jnp.float16,
+    "fp16": jnp.float16,
+    "int8": jnp.int8,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+
+def to_jax_dtype(dtype: Any):
+    """Resolve a string / jnp dtype spec to a jnp dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_MAP:
+            raise ValueError(f"unknown dtype {dtype!r}; expected one of {sorted(_DTYPE_MAP)}")
+        return _DTYPE_MAP[dtype]
+    return dtype
+
+
+def dtype_name(dtype: Any) -> str:
+    for name, dt in _DTYPE_MAP.items():
+        if dt == dtype and name in ("bfloat16", "float32", "float16", "int8",
+                                    "float8_e4m3fn", "float8_e5m2"):
+            return name
+    return str(dtype)
+
+
+@dataclass
+class OnDeviceSamplingConfig:
+    """On-device sampling knobs (reference: models/config.py:1064-1076)."""
+
+    do_sample: bool = False
+    top_k: int = 1
+    top_p: float = 1.0
+    temperature: float = 1.0
+    dynamic: bool = True          # per-request sampling params tensor
+    deterministic: bool = False
+    global_topk: int = 256        # stage-1 topk width for hierarchical top-k
+    on_device: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class ChunkedPrefillConfig:
+    """Chunked prefill / prefix caching (reference: models/config.py:1078-1094)."""
+
+    max_num_seqs: int = 8
+    kernel_q_tile_size: int = 128
+    kernel_kv_tile_size: int = 1024
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class MoEConfig:
+    """MoE knobs (reference: models/config.py:798-846 ``MoENeuronConfig``)."""
+
+    capacity_factor: Optional[float] = None   # None => full capacity (dropless)
+    glu_mlp: bool = True
+    glu_type: str = "glu"
+    normalize_top_k_affinities: bool = True
+    early_expert_affinity_modulation: bool = False
+    fused_shared_experts: bool = False
+    routed_scaling_factor: Optional[float] = None
+    moe_tp_degree: Optional[int] = None       # defaults to tp_degree
+    moe_ep_degree: Optional[int] = None       # defaults to ep_degree
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class LoraServingConfig:
+    """Multi-LoRA serving knobs (reference: modules/lora_serving/lora_serving_config.py)."""
+
+    max_loras: int = 1
+    max_lora_rank: int = 16
+    target_modules: Optional[List[str]] = None
+    lora_ckpt_paths: Optional[Dict[str, str]] = None
+    lora_dtype: str = "bfloat16"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class SpeculationConfig:
+    """Speculative decoding knobs (reference: models/config.py:243-274 block).
+
+    Covers vanilla draft/target, EAGLE and Medusa variants; the fused-spec
+    draft model class is referenced by import path so the config JSON
+    round-trips (reference: models/config.py:956-1038).
+    """
+
+    speculation_length: int = 0
+    spec_batch_size: Optional[int] = None
+    enable_fused_speculation: bool = False
+    enable_eagle_speculation: bool = False
+    enable_eagle_draft_input_norm: bool = False
+    is_eagle_draft: bool = False
+    medusa_speculation_length: int = 0
+    num_medusa_heads: int = 0
+    token_tree_config: Optional[Dict[str, Any]] = None
+    draft_model_path: Optional[str] = None
+    draft_model_module: Optional[str] = None  # "module:Class" for round-trip
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+_SUBCONFIG_TYPES = {
+    "on_device_sampling_config": OnDeviceSamplingConfig,
+    "chunked_prefill_config": ChunkedPrefillConfig,
+    "moe_config": MoEConfig,
+    "lora_config": LoraServingConfig,
+    "speculation_config": SpeculationConfig,
+}
+
+
+@dataclass
+class TpuConfig:
+    """TPU-native equivalent of the reference ``NeuronConfig``
+    (reference: models/config.py:84-786). Same knob names where sensible.
+
+    Parallelism degrees are mesh-axis sizes:
+      tp_degree -> "tp" axis, cp_degree -> "cp", attention_dp_degree -> "dp",
+      ep_degree -> "ep" (reference: models/config.py:361-375).
+    """
+
+    # --- batch / sequence geometry (reference: models/config.py:120-164) ---
+    batch_size: int = 1
+    ctx_batch_size: Optional[int] = None      # prefill batch
+    tkg_batch_size: Optional[int] = None      # decode batch
+    max_batch_size: Optional[int] = None
+    is_continuous_batching: bool = False
+    seq_len: int = 128                        # max total sequence length
+    max_context_length: Optional[int] = None  # max prefill length
+    n_active_tokens: int = 1
+    n_positions: Optional[int] = None
+
+    # --- dtypes ---
+    dtype: str = "bfloat16"                   # weights/activations
+    kv_cache_dtype: Optional[str] = None      # default = dtype; fp8 supported
+    logits_dtype: str = "float32"
+    rope_dtype: str = "float32"
+
+    # --- parallelism degrees (reference: models/config.py:361-390) ---
+    tp_degree: int = 1
+    cp_degree: int = 1                        # context parallel (prefill)
+    attention_dp_degree: int = 1              # data parallel decode attention
+    pp_degree: int = 1
+    ep_degree: int = 1
+    mlp_cp_degree: int = 1
+    sequence_parallel_enabled: bool = False
+    vocab_parallel: bool = False
+    world_size: Optional[int] = None
+    start_rank_id: int = 0
+    local_ranks_size: Optional[int] = None
+
+    # --- KV cache (reference: models/config.py:167-170, 277-317) ---
+    kv_cache_batch_size: Optional[int] = None
+    kv_cache_padding_size: int = 0
+    is_block_kv_layout: bool = False
+    pa_num_blocks: Optional[int] = None
+    pa_block_size: int = 32
+    is_prefix_caching: bool = False
+    is_chunked_prefill: bool = False
+    flash_decoding_enabled: bool = False
+
+    # --- bucketing (reference: models/config.py:186-213) ---
+    enable_bucketing: bool = True
+    buckets: Optional[List[int]] = None           # explicit decode buckets
+    context_encoding_buckets: Optional[List[int]] = None
+    token_generation_buckets: Optional[List[int]] = None
+    bucket_n_active_tokens: bool = False
+
+    # --- sampling ---
+    on_device_sampling_config: Optional[OnDeviceSamplingConfig] = None
+    output_logits: bool = False               # return logits (accuracy/debug)
+
+    # --- speculation ---
+    speculation_config: Optional[SpeculationConfig] = None
+
+    # --- MoE ---
+    moe_config: Optional[MoEConfig] = None
+
+    # --- LoRA ---
+    lora_config: Optional[LoraServingConfig] = None
+
+    # --- chunked prefill ---
+    chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
+
+    # --- quantization (reference: models/config.py:216-241) ---
+    quantized: bool = False
+    quantization_dtype: str = "int8"
+    quantization_type: str = "per_channel_symmetric"
+    quantized_checkpoints_path: Optional[str] = None
+    modules_to_not_convert: Optional[List[str]] = None
+    kv_cache_quant: bool = False
+
+    # --- kernels (reference: models/config.py:417-567 — ~25 enable flags) ---
+    attn_kernel_enabled: Optional[bool] = None   # None = auto heuristic
+    qkv_kernel_enabled: bool = False
+    mlp_kernel_enabled: bool = False
+    attn_block_tkg_nki_kernel_enabled: bool = False
+
+    # --- async / host loop (reference: models/config.py:183) ---
+    async_mode: bool = False
+    decode_chunk_tokens: int = 1              # tokens per device call in decode
+
+    # --- misc / runtime ---
+    rpl_reduce_dtype: Optional[str] = None
+    cast_type: str = "config"                 # or "as-declared"
+    save_sharded_checkpoint: bool = False
+    skip_sharding: bool = False
+    compile_cache_dir: Optional[str] = None
+    seed: int = 0
+
+    # note: unknown kwargs warn (reference: models/config.py:639-640) — handled
+    # by from_dict below.
+
+    def __post_init__(self):
+        if self.max_context_length is None:
+            self.max_context_length = self.seq_len
+        if self.max_batch_size is None:
+            self.max_batch_size = self.batch_size
+        if self.ctx_batch_size is None:
+            self.ctx_batch_size = 1 if self.is_continuous_batching else self.batch_size
+        if self.tkg_batch_size is None:
+            self.tkg_batch_size = self.batch_size
+        if self.kv_cache_batch_size is None:
+            self.kv_cache_batch_size = max(self.tkg_batch_size, self.max_batch_size)
+        if self.kv_cache_dtype is None:
+            self.kv_cache_dtype = self.dtype
+        if self.n_positions is None:
+            self.n_positions = self.seq_len
+        if self.world_size is None:
+            # tp_degree counts all model-parallel ranks; cp/dp/ep subdivide
+            # them rather than multiplying the world (reference:
+            # models/config.py:382-390 world-size calc)
+            self.world_size = self.tp_degree * self.pp_degree
+        if self.local_ranks_size is None:
+            self.local_ranks_size = self.world_size
+        self.validate()
+
+    # -- validation (reference: models/config.py:645-721) --
+    def validate(self):
+        if self.seq_len < 1:
+            raise ValueError("seq_len must be >= 1")
+        if self.max_context_length > self.seq_len:
+            raise ValueError(
+                f"max_context_length ({self.max_context_length}) cannot exceed "
+                f"seq_len ({self.seq_len})")
+        if self.cp_degree > 1 and self.tp_degree % self.cp_degree != 0:
+            raise ValueError("cp_degree must divide tp_degree (cp shards the tp axis "
+                             "during prefill)")
+        if self.attention_dp_degree > 1:
+            if self.tp_degree % self.attention_dp_degree != 0:
+                raise ValueError("attention_dp_degree must divide tp_degree")
+            if self.tkg_batch_size % self.attention_dp_degree != 0:
+                raise ValueError("tkg_batch_size must be divisible by attention_dp_degree")
+        if self.is_chunked_prefill and not self.is_block_kv_layout:
+            raise ValueError("chunked prefill requires block KV layout")
+        if self.is_prefix_caching and not self.is_block_kv_layout:
+            raise ValueError("prefix caching requires block KV layout")
+        if self.is_block_kv_layout and self.pa_num_blocks is None:
+            self.pa_num_blocks = (
+                self.kv_cache_batch_size * ((self.seq_len + self.pa_block_size - 1)
+                                            // self.pa_block_size))
+        spec = self.speculation_config
+        if spec and spec.enable_eagle_speculation and not spec.enable_fused_speculation:
+            raise ValueError("EAGLE speculation requires fused speculation")
+
+    # -- dtype helpers --
+    @property
+    def jax_dtype(self):
+        return to_jax_dtype(self.dtype)
+
+    @property
+    def jax_kv_dtype(self):
+        return to_jax_dtype(self.kv_cache_dtype)
+
+    @property
+    def jax_logits_dtype(self):
+        return to_jax_dtype(self.logits_dtype)
+
+    @property
+    def speculation_length(self) -> int:
+        return self.speculation_config.speculation_length if self.speculation_config else 0
+
+    # -- serialization (reference: models/config.py:927-1038 JSON round-trip) --
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if dataclasses.is_dataclass(v):
+                v = v.to_dict() if hasattr(v, "to_dict") else dataclasses.asdict(v)
+            out[f.name] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TpuConfig":
+        d = dict(d)
+        known = {f.name for f in dataclasses.fields(cls)}
+        for key, sub_cls in _SUBCONFIG_TYPES.items():
+            if isinstance(d.get(key), dict):
+                d[key] = sub_cls(**d[key])
+        unknown = [k for k in d if k not in known]
+        for k in unknown:
+            # warn-on-unknown (reference: models/config.py:639-640)
+            logger.warning("TpuConfig: ignoring unknown key %r", k)
+            d.pop(k)
+        return cls(**d)
+
+
+# Back-compat alias: reference users know this as NeuronConfig.
+NeuronConfig = TpuConfig
+
+
+@dataclass
+class MoETpuConfig(TpuConfig):
+    """Convenience subclass that always carries an MoEConfig
+    (reference: models/config.py:798 ``MoENeuronConfig``)."""
+
+    def __post_init__(self):
+        if self.moe_config is None:
+            self.moe_config = MoEConfig()
+        super().__post_init__()
+
+
+class InferenceConfig:
+    """Wrapper pairing a HF-style model config with a :class:`TpuConfig`
+    (reference: models/config.py:849-1042 ``InferenceConfig``).
+
+    Arbitrary HF config attributes live directly on the object; ``tpu_config``
+    (alias ``neuron_config``) holds runtime knobs. JSON round-trip via
+    :meth:`save` / :meth:`load`.
+    """
+
+    _NON_HF_KEYS = ("tpu_config",)
+
+    def __init__(self, tpu_config: TpuConfig, load_config=None, metadata=None, **kwargs):
+        self.tpu_config = tpu_config
+        self.metadata = metadata or {}
+        if load_config is not None:
+            if callable(load_config):
+                load_config(self)
+            else:
+                for k, v in dict(load_config).items():
+                    setattr(self, k, v)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.add_derived_config()
+        self.validate_config()
+
+    # alias to match reference naming
+    @property
+    def neuron_config(self) -> TpuConfig:
+        return self.tpu_config
+
+    def add_derived_config(self):
+        """Model families override to compute derived attributes
+        (reference: per-model ``setup_attr_for_model``)."""
+
+    def get_required_attributes(self) -> List[str]:
+        return []
+
+    def validate_config(self):
+        missing = [a for a in self.get_required_attributes() if not hasattr(self, a)]
+        if missing:
+            raise ValueError(f"InferenceConfig missing required attributes: {missing}")
+
+    def get_text_config(self) -> "InferenceConfig":
+        """Multimodal configs override to return the text sub-config
+        (reference: models/config.py:946)."""
+        return self
+
+    # -- serialization --
+    def to_dict(self) -> Dict[str, Any]:
+        hf = {k: v for k, v in self.__dict__.items()
+              if k not in self._NON_HF_KEYS and not k.startswith("_")
+              and _json_safe(v)}
+        return {"tpu_config": self.tpu_config.to_dict(), "hf_config": hf,
+                "config_cls": f"{type(self).__module__}:{type(self).__qualname__}"}
+
+    def to_json_string(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=str, sort_keys=True)
+
+    def save(self, path: str):
+        """Serialize next to compiled artifacts
+        (reference: models/config.py:927-944)."""
+        if os.path.isdir(path) or path.endswith(os.sep):
+            os.makedirs(path, exist_ok=True)
+            path = os.path.join(path, "tpu_inference_config.json")
+        else:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_json_string())
+
+    @classmethod
+    def from_json_string(cls, s: str) -> "InferenceConfig":
+        d = json.loads(s)
+        config_cls = cls
+        if "config_cls" in d and ":" in d.get("config_cls", ""):
+            import importlib
+            mod_name, qual = d["config_cls"].split(":")
+            try:
+                mod = importlib.import_module(mod_name)
+                config_cls = getattr(mod, qual.split(".")[-1], cls)
+            except ImportError:
+                logger.warning("could not re-import config class %s", d["config_cls"])
+        obj = config_cls.__new__(config_cls)
+        obj.tpu_config = TpuConfig.from_dict(d["tpu_config"])
+        obj.metadata = {}
+        for k, v in d.get("hf_config", {}).items():
+            setattr(obj, k, v)
+        obj.add_derived_config()
+        return obj
+
+    @classmethod
+    def load(cls, path: str) -> "InferenceConfig":
+        if os.path.isdir(path):
+            path = os.path.join(path, "tpu_inference_config.json")
+        with open(path) as f:
+            return cls.from_json_string(f.read())
+
+
+def _json_safe(v) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def load_pretrained_config(model_path: str):
+    """Build a load_config callable from a HF checkpoint dir's config.json
+    (reference: utils/hf_adapter.py:36 ``load_pretrained_config``)."""
+
+    def _load(cfg: InferenceConfig):
+        cfg_path = os.path.join(model_path, "config.json")
+        with open(cfg_path) as f:
+            hf = json.load(f)
+        for k, v in hf.items():
+            setattr(cfg, k, v)
+        cfg.model_path = model_path
+
+    return _load

@@ -1,0 +1,4 @@
+"""Model hub (reference: models/ — SURVEY §2.7)."""
+
+from . import family  # noqa: F401
+from .llama import modeling_llama  # noqa: F401  (registers "llama")

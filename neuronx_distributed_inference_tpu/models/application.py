@@ -1,0 +1,323 @@
+"""Application layer — compile/load/warmup lifecycle + host generation loop
+(reference: models/application_base.py ``NeuronApplicationBase``,
+models/model_wrapper.py ``ModelWrapper``, models/model_base.py
+``NeuronBaseForCausalLM``:3024).
+
+TPU redesign of the three reference classes into one:
+  * compile()  -> ``jax.jit(...).lower().compile()`` per (submodel, bucket);
+    the persistent XLA compilation cache replaces the NEFF artifact dir.
+  * load()     -> checkpoint load + convert + device_put with shardings.
+  * generate() -> host loop; the decode hot path runs ``decode_chunk_tokens``
+    steps per device call via lax.scan (see model_base.decode_loop), which is
+    the TPU replacement for async double-buffering
+    (reference: modules/async_execution.py).
+KV cache buffers are donated every call (reference I/O aliasing,
+model_wrapper.py:1578-1627).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import InferenceConfig, TpuConfig
+from ..modules import autobucketing
+from ..modules.kv_cache import KVCacheSpec, cache_pspec, init_cache
+from ..ops.sampling import prepare_sampling_params
+from ..parallel.mesh import AXIS_DP, AXIS_TP, MeshConfig, build_mesh, mesh_from_config
+from ..utils import checkpoint as ckpt
+from .family import DecoderFamily, family_for_config
+from . import model_base
+
+logger = logging.getLogger("nxdi_tpu")
+
+# Submodel tags (reference: models/model_wrapper.py:37-42)
+CONTEXT_ENCODING_MODEL_TAG = "context_encoding_model"
+TOKEN_GENERATION_MODEL_TAG = "token_generation_model"
+SPECULATION_MODEL_TAG = "speculation_model"
+FUSED_SPECULATION_MODEL_TAG = "fused_speculation_model"
+
+
+class CausalLMApplication:
+    """Compile/load/run a causal LM on a TPU mesh."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig,
+                 family: Optional[Type[DecoderFamily]] = None,
+                 mesh: Optional[Mesh] = None):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config: TpuConfig = config.tpu_config
+        self.family = family or family_for_config(config)
+        self.mesh = mesh if mesh is not None else mesh_from_config(self.tpu_config)
+        self.spec = self.family.build_spec(config, tp_degree=self.mesh.shape["tp"])
+        self.params = None
+        self.cache = None
+        self._compiled: Dict[Tuple[str, int], Any] = {}
+        self._rng = jax.random.PRNGKey(self.tpu_config.seed)
+        self.ctx_buckets = autobucketing.context_encoding_buckets(self.tpu_config)
+        self.tkg_buckets = autobucketing.token_generation_buckets(self.tpu_config)
+        if self.tpu_config.compile_cache_dir:
+            jax.config.update("jax_compilation_cache_dir",
+                              self.tpu_config.compile_cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    # ------------------------------------------------------------------
+    # weights
+    # ------------------------------------------------------------------
+    def load_weights(self, model_path: Optional[str] = None):
+        """Load + convert + shard a HF checkpoint
+        (reference: application_base.py:375-421 ``load_weights``)."""
+        path = model_path or self.model_path
+        sd = ckpt.load_state_dict(path)
+        host = self.family.convert_hf_state_dict(sd, self.spec)
+        shardings = model_base.param_shardings(self.spec, self.mesh)
+        self.params = ckpt.device_put_params(host, shardings,
+                                             dtype=self.spec.dtype)
+        return self
+
+    def init_random_weights(self, seed: int = 0):
+        """Synthetic weights (tiny-model tests / benches — reference:
+        modules/checkpoint.py:202-287)."""
+        self.params = model_base.init_params(self.spec, jax.random.PRNGKey(seed),
+                                             self.mesh)
+        return self
+
+    def init_cache(self):
+        cfg = self.tpu_config
+        spec = KVCacheSpec(
+            num_layers=self.spec.num_layers,
+            batch_size=cfg.kv_cache_batch_size,
+            max_seq_len=cfg.seq_len,
+            num_kv_heads=self.spec.gqa.num_kv_heads,
+            head_dim=self.spec.head_dim,
+            dtype=self.spec.kv_dtype,
+        )
+        self.cache = init_cache(spec, self.mesh)
+        return self
+
+    # ------------------------------------------------------------------
+    # compile
+    # ------------------------------------------------------------------
+    def _io_shardings(self):
+        repl = NamedSharding(self.mesh, P())
+        cache_sh = NamedSharding(self.mesh, cache_pspec())
+        return repl, cache_sh
+
+    def _jit_prefill(self):
+        fn = partial(model_base.context_encoding_step, self.spec, self.tpu_config)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _jit_decode(self):
+        fn = partial(model_base.token_generation_step, self.spec, self.tpu_config)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _jit_decode_loop(self, num_steps: int):
+        fn = partial(model_base.decode_loop, self.spec, self.tpu_config)
+        return jax.jit(fn, static_argnames=("num_steps",), donate_argnums=(1,))
+
+    def get_compiled(self, tag: str, bucket: int = 0):
+        key = (tag, bucket)
+        if key not in self._compiled:
+            if tag == CONTEXT_ENCODING_MODEL_TAG:
+                self._compiled[key] = self._jit_prefill()
+            elif tag == TOKEN_GENERATION_MODEL_TAG:
+                self._compiled[key] = self._jit_decode()
+            elif tag == "decode_loop":
+                self._compiled[key] = self._jit_decode_loop(bucket)
+            else:
+                raise KeyError(tag)
+        return self._compiled[key]
+
+    def compile(self, compiled_model_path: Optional[str] = None):
+        """AOT warm the compilation cache for every (submodel, bucket)
+        (reference: application_base.py:292-316 ``compile``). With the
+        persistent XLA cache enabled this also serializes executables."""
+        if compiled_model_path:
+            os.makedirs(compiled_model_path, exist_ok=True)
+            self.config.save(compiled_model_path + os.sep)
+            if not self.tpu_config.compile_cache_dir:
+                jax.config.update("jax_compilation_cache_dir", compiled_model_path)
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        self.warmup()
+        return self
+
+    def warmup(self):
+        """Run every bucket once (reference: application_base.py:349-373)."""
+        if self.params is None:
+            self.init_random_weights()
+        if self.cache is None:
+            self.init_cache()
+        cfg = self.tpu_config
+        b = cfg.ctx_batch_size
+        for s in self.ctx_buckets:
+            self._run_prefill(np.zeros((b, s), np.int32),
+                              np.zeros((b,), np.int32) + 1)
+        bt = cfg.tkg_batch_size
+        chunk = max(cfg.decode_chunk_tokens, 1)
+        if chunk > 1:
+            self._run_decode_loop(np.zeros((bt,), np.int32),
+                                  np.ones((bt,), np.int32), chunk)
+        else:
+            self._run_decode(np.zeros((bt, 1), np.int32),
+                             np.ones((bt, 1), np.int32))
+        return self
+
+    # ------------------------------------------------------------------
+    # execution helpers
+    # ------------------------------------------------------------------
+    def _next_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _default_sampling_params(self, batch: int):
+        sc = self.tpu_config.on_device_sampling_config
+        if sc is None:
+            return None
+        return jnp.asarray(prepare_sampling_params(
+            batch, sc.top_k, sc.top_p, sc.temperature))
+
+    def _run_prefill(self, input_ids: np.ndarray, seq_lens: np.ndarray,
+                     seq_ids: Optional[np.ndarray] = None,
+                     sampling_params=None):
+        b, s = input_ids.shape
+        if seq_ids is None:
+            seq_ids = np.arange(b, dtype=np.int32)
+        position_ids = np.broadcast_to(np.arange(s, dtype=np.int32), (b, s))
+        fn = self.get_compiled(CONTEXT_ENCODING_MODEL_TAG, s)
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(b)
+        out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                 jnp.asarray(position_ids), jnp.asarray(seq_ids),
+                 jnp.asarray(seq_lens), sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        return out
+
+    def _run_decode(self, input_ids: np.ndarray, position_ids: np.ndarray,
+                    seq_ids: Optional[np.ndarray] = None, sampling_params=None):
+        b = input_ids.shape[0]
+        if seq_ids is None:
+            seq_ids = np.arange(b, dtype=np.int32)
+        fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG)
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(b)
+        out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                 jnp.asarray(position_ids), jnp.asarray(seq_ids),
+                 sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        return out
+
+    def _run_decode_loop(self, first_tokens: np.ndarray, positions: np.ndarray,
+                         num_steps: int, seq_ids: Optional[np.ndarray] = None,
+                         sampling_params=None):
+        b = first_tokens.shape[0]
+        if seq_ids is None:
+            seq_ids = np.arange(b, dtype=np.int32)
+        fn = self.get_compiled("decode_loop", num_steps)
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(b)
+        out = fn(self.params, self.cache, jnp.asarray(first_tokens),
+                 jnp.asarray(positions), jnp.asarray(seq_ids), sampling_params,
+                 self._next_rng(), num_steps=num_steps)
+        self.cache = out["cache"]
+        return out
+
+    # ------------------------------------------------------------------
+    # generation (reference: utils/hf_adapter.py _sample loop :139-258 +
+    # NeuronBaseForCausalLM._get_model_outputs routing :3549-3735)
+    # ------------------------------------------------------------------
+    def generate(self, input_ids: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None,
+                 sampling_params: Optional[np.ndarray] = None,
+                 return_logits: bool = False) -> Dict[str, Any]:
+        """Greedy/sampled generation. input_ids (B, S) right-padded;
+        attention_mask (B, S) marks real tokens. Returns sequences including
+        the prompt (HF convention)."""
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_lens = attention_mask.astype(np.int32).sum(axis=1)
+        if self.cache is None:
+            self.init_cache()
+        if self.params is None:
+            raise RuntimeError("load_weights() or init_random_weights() first")
+        if sampling_params is not None:
+            sampling_params = jnp.asarray(sampling_params)
+
+        bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
+        padded = np.zeros((b, bucket), input_ids.dtype)
+        padded[:, :s] = input_ids
+        max_total = int(seq_lens.max()) + max_new_tokens
+        if max_total > self.tpu_config.seq_len:
+            max_new_tokens = self.tpu_config.seq_len - int(seq_lens.max())
+            if max_new_tokens <= 0:
+                raise ValueError("prompt exceeds seq_len")
+
+        t0 = time.perf_counter()
+        out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params)
+        tokens = np.asarray(out["tokens"]).reshape(b, 1)
+        logits_trace = [np.asarray(out["logits"])] if return_logits and "logits" in out else []
+        ttft = time.perf_counter() - t0
+
+        collected = [tokens]
+        positions = seq_lens.astype(np.int32)  # position of the token just sampled
+        n_generated = 1
+        eos_seen = np.zeros((b,), bool) if eos_token_id is not None else None
+        if eos_seen is not None:
+            eos_seen |= tokens[:, 0] == eos_token_id
+        chunk = max(self.tpu_config.decode_chunk_tokens, 1)
+        while n_generated < max_new_tokens:
+            remaining = max_new_tokens - n_generated
+            # only the full-chunk loop graph is warmed; a partial remainder
+            # would trigger a fresh XLA compile mid-request, so finish it with
+            # the (already-compiled) single-step graph instead
+            n = chunk if remaining >= chunk else 1
+            cur = collected[-1][:, -1]
+            if n == 1 or return_logits:
+                o = self._run_decode(cur[:, None], positions[:, None],
+                                     sampling_params=sampling_params)
+                new = np.asarray(o["tokens"]).reshape(b, 1)
+                if return_logits and "logits" in o:
+                    logits_trace.append(np.asarray(o["logits"]))
+                positions = positions + 1
+                n_generated += 1
+            else:
+                o = self._run_decode_loop(cur, positions, n,
+                                          sampling_params=sampling_params)
+                new = np.asarray(o["tokens"])
+                positions = positions + n
+                n_generated += n
+            collected.append(new)
+            if eos_seen is not None:
+                eos_seen |= (new == eos_token_id).any(axis=1)
+                if eos_seen.all():
+                    break
+
+        gen = np.concatenate(collected, axis=1)
+        # trim past first eos per row (tokens after eos are garbage by HF convention)
+        if eos_token_id is not None:
+            for i in range(b):
+                hits = np.where(gen[i] == eos_token_id)[0]
+                if hits.size:
+                    gen[i, hits[0] + 1:] = eos_token_id
+        sequences = np.concatenate([input_ids, gen], axis=1)
+        result = {"sequences": sequences, "generated": gen, "ttft_s": ttft,
+                  "seq_lens": seq_lens}
+        if return_logits:
+            result["logits"] = logits_trace
+        return result
+
+    def reset(self):
+        """Clear KV cache between requests."""
+        self.init_cache()
+        return self

@@ -1,0 +1,147 @@
+"""Model-family protocol (reference analog: the per-model contract described
+in SURVEY §2.7 — each family provides ``get_required_attributes``,
+``setup_attr_for_model``, ``init_model``, ``convert_hf_to_neuron_state_dict``,
+``load_hf_model``).
+
+A family here is a class with:
+  * ``config_cls``            — InferenceConfig subclass
+  * ``build_spec(config)``    — InferenceConfig -> DecoderSpec
+  * ``convert_hf_state_dict`` — HF numpy state dict -> stacked TPU param tree
+  * ``load_hf_model(path)``   — CPU torch model for golden accuracy checks
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ..config import InferenceConfig
+from ..parallel.layers import place_q_weight, replicate_kv_weight
+from .model_base import DecoderSpec, spec_from_config
+
+_REGISTRY: Dict[str, Type["DecoderFamily"]] = {}
+
+
+def register_family(*names: str):
+    def deco(cls):
+        for n in names:
+            _REGISTRY[n] = cls
+        cls.family_names = names
+        return cls
+    return deco
+
+
+def get_family(name: str) -> Type["DecoderFamily"]:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model family {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def family_for_config(config) -> Type["DecoderFamily"]:
+    mt = getattr(config, "model_type", None)
+    return get_family(mt)
+
+
+class DecoderFamily:
+    """Base implementation that covers the standard Llama-shaped decoder.
+    Families override hooks for their deltas (bias, qk-norm, soft caps, MoE)."""
+
+    family_names = ()
+    config_cls: Type[InferenceConfig] = InferenceConfig
+    hf_prefix = "model"
+    spec_overrides: Dict[str, Any] = {}
+
+    # -- spec --
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        return spec_from_config(config, tp_degree, **cls.spec_overrides)
+
+    # -- weights --
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray], spec: DecoderSpec
+                              ) -> Dict[str, Any]:
+        """HF names/layouts -> stacked TPU tree
+        (reference analog: convert_hf_to_neuron_state_dict per model).
+
+        torch Linear stores (out, in); we store (in, out) so matmuls read
+        x @ w. Q/K/V head padding + KV replication happen here at load time
+        (reference: gqa.py preshard_hook :679+)."""
+        p = cls.hf_prefix
+        g = spec.gqa
+        D = spec.head_dim
+
+        def get(name):
+            if name in sd:
+                return np.asarray(sd[name])
+            raise KeyError(f"missing checkpoint tensor {name}; have "
+                           f"{sorted(k for k in sd)[:8]}...")
+
+        def layer_stack(fmt, transform):
+            return np.stack(
+                [transform(get(fmt.format(i=i))) for i in range(spec.num_layers)])
+
+        def q_t(w):  # (nq*D, H) -> (H, padded_q*D)
+            return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=-1)
+
+        def kv_t(w):
+            return replicate_kv_weight(np.ascontiguousarray(w.T), g, D, axis=-1)
+
+        def o_t(w):  # (H, nq*D) -> (padded_q*D, H): place on input axis
+            return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=0)
+
+        def t(w):
+            return np.ascontiguousarray(w.T)
+
+        def ident(w):
+            return np.asarray(w)
+
+        layers = {
+            "input_norm": layer_stack(p + ".layers.{i}.input_layernorm.weight", ident),
+            "q_proj": layer_stack(p + ".layers.{i}.self_attn.q_proj.weight", q_t),
+            "k_proj": layer_stack(p + ".layers.{i}.self_attn.k_proj.weight", kv_t),
+            "v_proj": layer_stack(p + ".layers.{i}.self_attn.v_proj.weight", kv_t),
+            "o_proj": layer_stack(p + ".layers.{i}.self_attn.o_proj.weight", o_t),
+            "post_norm": layer_stack(
+                p + ".layers.{i}.post_attention_layernorm.weight", ident),
+            "gate_proj": layer_stack(p + ".layers.{i}.mlp.gate_proj.weight", t),
+            "up_proj": layer_stack(p + ".layers.{i}.mlp.up_proj.weight", t),
+            "down_proj": layer_stack(p + ".layers.{i}.mlp.down_proj.weight", t),
+        }
+        if spec.qkv_bias:
+            def q_b(b):
+                return place_q_weight(b, g, D)
+
+            def kv_b(b):
+                return replicate_kv_weight(b, g, D)
+
+            layers["q_bias"] = layer_stack(p + ".layers.{i}.self_attn.q_proj.bias", q_b)
+            layers["k_bias"] = layer_stack(p + ".layers.{i}.self_attn.k_proj.bias", kv_b)
+            layers["v_bias"] = layer_stack(p + ".layers.{i}.self_attn.v_proj.bias", kv_b)
+        if spec.qk_norm:
+            layers["q_norm"] = layer_stack(p + ".layers.{i}.self_attn.q_norm.weight", ident)
+            layers["k_norm"] = layer_stack(p + ".layers.{i}.self_attn.k_norm.weight", ident)
+
+        def vpad(w):  # pad vocab rows to padded_vocab
+            if w.shape[0] < spec.padded_vocab:
+                w = np.pad(w, [(0, spec.padded_vocab - w.shape[0])] +
+                           [(0, 0)] * (w.ndim - 1))
+            return w
+
+        out = {
+            "embed": vpad(get(p + ".embed_tokens.weight")),
+            "layers": layers,
+            "final_norm": get(p + ".norm.weight"),
+        }
+        if not spec.tie_word_embeddings:
+            out["lm_head"] = np.ascontiguousarray(vpad(get("lm_head.weight")).T)
+        return out
+
+    # -- golden --
+    @classmethod
+    def load_hf_model(cls, model_path: str):
+        """CPU torch model for golden logit generation
+        (reference: each model's load_hf_model; utils/accuracy.py golden flow)."""
+        import transformers
+        return transformers.AutoModelForCausalLM.from_pretrained(model_path)

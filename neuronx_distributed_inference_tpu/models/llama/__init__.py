@@ -1,0 +1,2 @@
+"""Llama model family."""
+from .modeling_llama import LlamaFamily, LlamaInferenceConfig, TpuLlamaForCausalLM  # noqa: F401

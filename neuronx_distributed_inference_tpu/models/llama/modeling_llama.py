@@ -1,0 +1,31 @@
+"""Llama family (2 / 3.x) — the flagship
+(reference: models/llama/modeling_llama.py ``NeuronLlamaForCausalLM``:1192).
+
+Llama3.1 scaled RoPE (reference :805) is handled generically by
+ops/rope.py's "llama3" scaling type, selected from the HF rope_scaling dict.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+
+
+class LlamaInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size",
+                "intermediate_size", "rms_norm_eps"]
+
+
+@register_family("llama")
+class LlamaFamily(DecoderFamily):
+    config_cls = LlamaInferenceConfig
+
+
+# Application-level alias matching the reference entry-class naming.
+def TpuLlamaForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, LlamaFamily)

@@ -1,0 +1,414 @@
+"""Functional decoder model base — the traced-graph layer of the framework
+(reference: models/model_base.py ``NeuronBaseModel``:70-1596).
+
+TPU-first redesign:
+  * The reference builds an nn.Module and traces it per (submodel, bucket).
+    Here the model IS a pure function ``(params, cache, inputs) -> outputs``;
+    ``jax.jit`` + AOT lowering replaces ModelBuilder.trace.
+  * The per-layer Python loop (reference: get_model_output :1216-1469) becomes
+    ``lax.scan`` over stacked layer weights — one compiled layer body,
+    O(1) compile time in depth, XLA-pipelined.
+  * KV-cache persistence via donated buffers (reference used I/O aliasing,
+    model_wrapper.py:1578-1627).
+  * On-device sampling (reference: :1151-1185) runs at the end of the graph.
+
+Two step graphs per model, mirroring the reference submodel tags
+(model_wrapper.py:37-42): ``context_encoding`` (prefill) and
+``token_generation`` (decode). Speculation graphs live in
+models/speculation.py; both reuse the layer stack here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import InferenceConfig, TpuConfig
+from ..ops import attention as attn_ops
+from ..ops import sampling as sampling_ops
+from ..ops.normalization import rms_norm
+from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
+from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
+                               replicated_param, resolve_gqa_sharding,
+                               row_parallel)
+from ..parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+from ..modules import kv_cache as kv
+
+ACT_FNS = {
+    "silu": jax.nn.silu,
+    "gelu": partial(jax.nn.gelu, approximate=False),
+    "gelu_new": partial(jax.nn.gelu, approximate=True),
+    "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
+}
+
+
+@dataclass(frozen=True)
+class DecoderSpec:
+    """Static architecture description, resolved from an InferenceConfig.
+
+    This is the single source of truth the traced functions close over —
+    everything here must be hashable/static for jit.
+    """
+
+    num_layers: int
+    hidden_size: int
+    num_q_heads: int          # original HF head count
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    vocab_size: int
+    padded_vocab: int
+    rms_eps: float
+    rope: RopeConfig
+    act: str = "silu"
+    gqa: GQASharding = None   # resolved for the mesh tp degree
+    qkv_bias: bool = False
+    o_bias: bool = False
+    mlp_bias: bool = False
+    qk_norm: bool = False     # qwen3-style per-head q/k RMSNorm
+    tie_word_embeddings: bool = False
+    sliding_window: int = 0   # 0 = full attention
+    logits_soft_cap: Optional[float] = None
+    attn_soft_cap: Optional[float] = None
+    attn_scale: Optional[float] = None   # None => head_dim ** -0.5
+    embed_scale: Optional[float] = None  # gemma multiplies embeddings
+    dtype: Any = jnp.bfloat16
+    kv_dtype: Any = jnp.bfloat16
+
+    @property
+    def scale(self) -> float:
+        return self.attn_scale if self.attn_scale is not None else self.head_dim ** -0.5
+
+    @property
+    def q_size(self) -> int:
+        return self.gqa.num_q_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.gqa.num_kv_heads * self.head_dim
+
+
+def pad_vocab(vocab: int, tp: int, multiple: int = 128) -> int:
+    m = max(tp, 1) * multiple
+    return int(np.ceil(vocab / m) * m)
+
+
+# ---------------------------------------------------------------------------
+# Parameter specs (shapes + shardings) — reference analog: the parallel-layer
+# module tree built in each model's init_model.
+# ---------------------------------------------------------------------------
+
+def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
+    L, H, I = spec.num_layers, spec.hidden_size, spec.intermediate_size
+    dt = spec.dtype
+    layers: Dict[str, ParamSpec] = {
+        "input_norm": ParamSpec((L, H), P(), dt, "ones"),
+        "q_proj": column_parallel(H, spec.q_size, dt, True, L),
+        "k_proj": column_parallel(H, spec.kv_size, dt, True, L),
+        "v_proj": column_parallel(H, spec.kv_size, dt, True, L),
+        "o_proj": row_parallel(spec.q_size, H, dt, True, L),
+        "post_norm": ParamSpec((L, H), P(), dt, "ones"),
+        "gate_proj": column_parallel(H, I, dt, True, L),
+        "up_proj": column_parallel(H, I, dt, True, L),
+        "down_proj": row_parallel(I, H, dt, True, L),
+    }
+    if spec.qkv_bias:
+        layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_TP), dt, "zeros")
+        layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_TP), dt, "zeros")
+        layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_TP), dt, "zeros")
+    if spec.qk_norm:
+        layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
+        layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
+    out: Dict[str, Any] = {
+        "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_TP, None), dt),
+        "layers": layers,
+        "final_norm": ParamSpec((H,), P(), dt, "ones"),
+    }
+    if not spec.tie_word_embeddings:
+        out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_TP), dt)
+    return out
+
+
+def init_params(spec: DecoderSpec, key: jax.Array,
+                mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    """Random-init a sharded param tree (tiny-model tests / benchmarks with
+    synthetic weights — reference: modules/checkpoint.py:202-287 random
+    N-layer checkpoint creation)."""
+    specs = decoder_param_specs(spec)
+    flat, treedef = jax.tree.flatten(specs, is_leaf=lambda x: isinstance(x, ParamSpec))
+    keys = jax.random.split(key, len(flat))
+    leaves = []
+    for k, ps in zip(keys, flat):
+        x = ps.initializer(k)
+        if mesh is not None:
+            x = jax.device_put(x, NamedSharding(mesh, ps.pspec))
+        leaves.append(x)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def param_shardings(spec: DecoderSpec, mesh: Mesh):
+    specs = decoder_param_specs(spec)
+    return jax.tree.map(lambda ps: NamedSharding(mesh, ps.pspec), specs,
+                        is_leaf=lambda x: isinstance(x, ParamSpec))
+
+
+# ---------------------------------------------------------------------------
+# Layer stack
+# ---------------------------------------------------------------------------
+
+def _shard(x, *spec):
+    """Sharding-constraint helper; no-op outside a mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
+    b, t, _ = x.shape
+    return x.reshape(b, t, n_heads, head_dim)
+
+
+def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
+                cos, sin, mask, seq_ids, positions, phase: str):
+    """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D).
+
+    phase "prefill": attend within the window only (no prior cache read),
+      then write the window into the cache (reference CTE path).
+    phase "decode": write active tokens into cache, attend over full cache
+      (reference TKG path; the reference's decomposed prior/active attention
+      attention_base.py:1383-1461 is one fused softmax over the cache here —
+      XLA fuses it, no manual decomposition needed).
+    """
+    g = spec.gqa
+    dtype = hidden.dtype
+    h = rms_norm(hidden, layer_w["input_norm"], spec.rms_eps)
+    q = h @ layer_w["q_proj"]
+    k = h @ layer_w["k_proj"]
+    v = h @ layer_w["v_proj"]
+    if spec.qkv_bias:
+        q = q + layer_w["q_bias"]
+        k = k + layer_w["k_bias"]
+        v = v + layer_w["v_bias"]
+    q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
+    k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
+    v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
+    if spec.qk_norm:
+        q = rms_norm(q, layer_w["q_norm"], spec.rms_eps)
+        k = rms_norm(k, layer_w["k_norm"], spec.rms_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if phase == "prefill":
+        attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
+                                logits_soft_cap=spec.attn_soft_cap)
+        new_k = kv.write_prefill(k_cache, kv.quantize_kv(k, k_cache.dtype), seq_ids)
+        new_v = kv.write_prefill(v_cache, kv.quantize_kv(v, v_cache.dtype), seq_ids)
+    else:
+        new_k = kv.write_tokens(k_cache, kv.quantize_kv(k, k_cache.dtype),
+                                seq_ids, positions)
+        new_v = kv.write_tokens(v_cache, kv.quantize_kv(v, v_cache.dtype),
+                                seq_ids, positions)
+        k_all = kv.gather_cache_rows(new_k, seq_ids).astype(dtype)
+        v_all = kv.gather_cache_rows(new_v, seq_ids).astype(dtype)
+        attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
+                                logits_soft_cap=spec.attn_soft_cap)
+
+    attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
+    h = attn_out @ layer_w["o_proj"]
+    hidden = hidden + _shard(h, AXIS_DP, None, None)
+
+    h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps)
+    act = ACT_FNS[spec.act]
+    inter = act(h @ layer_w["gate_proj"]) * (h @ layer_w["up_proj"])
+    inter = _shard(inter, AXIS_DP, None, AXIS_TP)
+    h = inter @ layer_w["down_proj"]
+    hidden = hidden + _shard(h, AXIS_DP, None, None)
+    return hidden, new_k, new_v
+
+
+def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
+               seq_ids, positions, phase: str):
+    """lax.scan over the stacked layer weights.
+
+    Replaces the reference's per-layer Python loop
+    (models/model_base.py:1216-1469 get_model_output).
+    Returns (hidden, new_cache).
+    """
+
+    def body(carry, xs):
+        layer_w, kc, vc = xs
+        h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, cos, sin, mask,
+                                seq_ids, positions, phase)
+        return h, (nk, nv)
+
+    hidden, (new_k, new_v) = jax.lax.scan(
+        body, hidden, (params["layers"], cache["k"], cache["v"]))
+    return hidden, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# Step graphs
+# ---------------------------------------------------------------------------
+
+def _embed(spec: DecoderSpec, params, input_ids):
+    h = params["embed"][input_ids]        # sharded-vocab gather; XLA SPMD handles
+    if spec.embed_scale is not None:
+        h = (h.astype(jnp.float32) * spec.embed_scale).astype(h.dtype)
+    return _shard(h, AXIS_DP, None, None)
+
+
+def _lm_head(spec: DecoderSpec, params, hidden):
+    h = rms_norm(hidden, params["final_norm"], spec.rms_eps)
+    w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
+    logits = (h @ w).astype(jnp.float32)
+    if spec.logits_soft_cap:
+        logits = spec.logits_soft_cap * jnp.tanh(logits / spec.logits_soft_cap)
+    logits = sampling_ops.mask_padded_logits(logits, spec.padded_vocab - spec.vocab_size)
+    return _shard(logits, AXIS_DP, None, AXIS_TP)
+
+
+def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                          input_ids, position_ids, seq_ids, seq_lens,
+                          sampling_params, rng):
+    """Prefill graph (reference submodel tag ``context_encoding_model``).
+
+    input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
+    Returns dict(tokens (B,), last_logits (B, V) [optional], cache).
+    """
+    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    mask = attn_ops.prefill_causal_mask(input_ids.shape[1], position_ids,
+                                        window=spec.sliding_window)
+    # padded positions: mask rows beyond seq_len attend only to themselves —
+    # harmless, their outputs are discarded.
+    hidden = _embed(spec, params, input_ids)
+    hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
+                                   seq_ids, position_ids, "prefill")
+    # last-token gather (reference: lm-head index + logit padding mask :987-999)
+    idx = jnp.maximum(seq_lens - 1, 0)
+    last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
+    logits = _lm_head(spec, params, last_h)[:, 0, :]
+    out = {"cache": new_cache}
+    if tpu_cfg.output_logits:
+        full_logits = _lm_head(spec, params, hidden)
+        out["logits"] = full_logits[..., :spec.vocab_size]
+    out["tokens"] = sampling_ops.sample(
+        logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
+    return out
+
+
+def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                          input_ids, position_ids, seq_ids,
+                          sampling_params, rng):
+    """Decode graph (reference submodel tag ``token_generation_model``).
+
+    input_ids (B, T) with T = 1 (or speculation window).
+    """
+    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    cache_len = cache["k"].shape[2]
+    mask = attn_ops.decode_mask(position_ids, cache_len,
+                                window=spec.sliding_window)
+    hidden = _embed(spec, params, input_ids)
+    hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
+                                   seq_ids, position_ids, "decode")
+    logits = _lm_head(spec, params, hidden)
+    out = {"cache": new_cache}
+    if tpu_cfg.output_logits:
+        out["logits"] = logits[..., :spec.vocab_size]
+    out["tokens"] = sampling_ops.sample(
+        logits[:, -1, :], tpu_cfg.on_device_sampling_config, sampling_params, rng)
+    return out
+
+
+def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                first_tokens, position_ids, seq_ids, sampling_params, rng,
+                num_steps: int):
+    """Fused multi-token decode: ``lax.scan`` of ``num_steps`` decode steps in
+    ONE device call. This is the TPU answer to the reference's async
+    double-buffering (modules/async_execution.py) — instead of hiding the
+    host-device round trip, we eliminate num_steps-1 of them.
+
+    first_tokens (B,): the token to feed at the first step.
+    position_ids (B,): position of first_tokens.
+    Returns (tokens (B, num_steps), cache).
+    """
+
+    def step(carry, step_rng):
+        tok, pos, cch = carry
+        out = token_generation_step(
+            spec, replace_output_logits(tpu_cfg), params, cch,
+            tok[:, None], pos[:, None], seq_ids, sampling_params, step_rng)
+        nxt = out["tokens"]
+        return (nxt, pos + 1, out["cache"]), nxt
+
+    rngs = jax.random.split(rng, num_steps)
+    (_, _, new_cache), toks = jax.lax.scan(
+        step, (first_tokens, position_ids, cache), rngs)
+    return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
+
+
+def replace_output_logits(cfg: TpuConfig) -> TpuConfig:
+    """decode_loop never returns per-step logits. Called at trace time only,
+    so a plain copy per call is fine."""
+    if not cfg.output_logits:
+        return cfg
+    import copy
+    c2 = copy.copy(cfg)
+    c2.output_logits = False
+    return c2
+
+
+# ---------------------------------------------------------------------------
+# Spec resolution from InferenceConfig
+# ---------------------------------------------------------------------------
+
+def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
+                     **overrides) -> DecoderSpec:
+    """Build a DecoderSpec from HF-style attributes on an InferenceConfig
+    (reference analog: each model's ``setup_attr_for_model`` + init_model)."""
+    tcfg = config.tpu_config
+    tp = tp_degree if tp_degree is not None else tcfg.tp_degree
+    n_q = config.num_attention_heads
+    n_kv = getattr(config, "num_key_value_heads", None) or n_q
+    head_dim = getattr(config, "head_dim", None) or config.hidden_size // n_q
+    gqa = resolve_gqa_sharding(n_q, n_kv, tp)
+    rope_scaling = getattr(config, "rope_scaling", None) or {}
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+    rope = RopeConfig(
+        head_dim=head_dim,
+        rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        rotary_dim=getattr(config, "rotary_dim", None),
+        scaling_type=rope_type,
+        scaling_factor=float(rope_scaling.get("factor", 1.0)),
+        low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
+        high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
+        original_max_position=int(rope_scaling.get(
+            "original_max_position_embeddings", 8192)),
+    )
+    vocab = config.vocab_size
+    kw = dict(
+        num_layers=config.num_hidden_layers,
+        hidden_size=config.hidden_size,
+        num_q_heads=n_q,
+        num_kv_heads=n_kv,
+        head_dim=head_dim,
+        intermediate_size=config.intermediate_size,
+        vocab_size=vocab,
+        padded_vocab=pad_vocab(vocab, tp),
+        rms_eps=float(getattr(config, "rms_norm_eps", 1e-6)),
+        rope=rope,
+        act=getattr(config, "hidden_act", "silu"),
+        gqa=gqa,
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", False)),
+        sliding_window=0,
+        dtype=tcfg.jax_dtype,
+        kv_dtype=tcfg.jax_kv_dtype,
+    )
+    kw.update(overrides)
+    return DecoderSpec(**kw)

@@ -1,0 +1,55 @@
+"""Bucket ladder generation (reference: modules/autobucketing.py).
+
+Buckets are the static shapes we AOT-compile; the host pads each request to
+the smallest bucket that fits (reference: generate_buckets :8-20 — powers of
+two between min and max)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def generate_buckets(min_len: int, max_len: int) -> List[int]:
+    """Powers-of-2 ladder from min to max, always including max
+    (reference: autobucketing.py:8-20)."""
+    if min_len >= max_len:
+        return [max_len]
+    buckets = []
+    b = max(min_len, 1)
+    # round min up to a power of two
+    while b & (b - 1):
+        b += b & -b
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+def context_encoding_buckets(tpu_config) -> List[int]:
+    """Prefill bucket ladder (reference: autobucketing.py:149)."""
+    if not tpu_config.enable_bucketing:
+        return [tpu_config.max_context_length]
+    if tpu_config.context_encoding_buckets:
+        return sorted(tpu_config.context_encoding_buckets)
+    return generate_buckets(128, tpu_config.max_context_length)
+
+
+def token_generation_buckets(tpu_config) -> List[int]:
+    """Decode-side bucket ladder over total sequence length
+    (reference: autobucketing.py:226). With a contiguous cache the decode
+    graph attends over the full cache, so decode buckets = [seq_len] unless
+    explicitly configured."""
+    if not tpu_config.enable_bucketing:
+        return [tpu_config.seq_len]
+    if tpu_config.token_generation_buckets:
+        return sorted(tpu_config.token_generation_buckets)
+    return [tpu_config.seq_len]
+
+
+def get_target_bucket(buckets: List[int], length: int) -> int:
+    """Smallest bucket >= length (reference: model_wrapper.py:831-921)."""
+    for b in buckets:
+        if b >= length:
+            return b
+    raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")

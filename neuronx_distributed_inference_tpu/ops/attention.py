@@ -1,0 +1,126 @@
+"""Attention ops — XLA-native path (reference:
+modules/attention/attention_base.py ``NeuronAttentionBase``).
+
+The reference dispatches between NKI flash kernels and a native compiler path
+(FlashAttentionStrategy.NONE, attention_base.py:985-1034). Here the roles are
+mirrored: this module is the always-available XLA path (XLA already tiles these
+einsums onto the MXU and fuses the softmax); a Pallas flash kernel
+(``ops/flash_attention.py``, added separately) is the fast path for
+long-context prefill.
+
+Layout conventions (TPU-friendly: head_dim last = 128-lane dim):
+  q:        (B, T, Hq, D)
+  k/v:      (B, S, Hkv, D)
+  mask:     (B, T, S) boolean, True = attend
+All softmax math in fp32 (matches reference numerics: manual_softmax in
+modules/attention/utils.py computes in fp32).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+NEG_INF = -30000.0  # large-negative fill used instead of -inf (reference uses
+                    # torch.finfo.min clamps; finite value avoids fp16/bf16 NaNs)
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, Hkv, D) -> (B, S, Hkv*n_rep, D)
+    (reference: modules/attention/utils.py ``repeat_kv``)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+        mask: Optional[jnp.ndarray], scale: float,
+        logits_soft_cap: Optional[float] = None,
+        sink: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Masked multi-head attention core with GQA grouping.
+
+    q (B,T,Hq,D), k/v (B,S,Hkv,D); Hq % Hkv == 0. Returns (B,T,Hq,D).
+    ``sink``: per-head learned softmax sink logits (B-broadcast), shape (Hq,)
+    (reference: modules/attention/sink.py — gpt-oss learned sinks).
+    """
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qf = q.astype(jnp.float32).reshape(b, t, hkv, g, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # scores: (B, Hkv, G, T, S)
+    scores = jnp.einsum("bthgd,bshd->bhgts", qf, kf) * scale
+    if logits_soft_cap is not None:
+        scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    if sink is not None:
+        # append a virtual sink column to the softmax denominator
+        sink_col = jnp.broadcast_to(
+            sink.astype(jnp.float32).reshape(1, hkv, g, 1, 1),
+            (b, hkv, g, t, 1))
+        scores_all = jnp.concatenate([scores, sink_col], axis=-1)
+        m = jnp.max(scores_all, axis=-1, keepdims=True)
+        e = jnp.exp(scores_all - m)
+        probs = (e / jnp.sum(e, axis=-1, keepdims=True))[..., :-1]
+    else:
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        e = jnp.exp(scores - m)
+        probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, vf)
+    return out.reshape(b, t, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Mask construction (reference: models/model_base.py:197-376 — causal /
+# windowed / chunked / speculation masks built on device from position ids)
+# ---------------------------------------------------------------------------
+
+def causal_mask(position_ids: jnp.ndarray, kv_positions: jnp.ndarray,
+                kv_valid: Optional[jnp.ndarray] = None,
+                window: int = 0, chunk: int = 0) -> jnp.ndarray:
+    """Boolean attend-mask (B, T, S) from query positions (B, T) and key
+    positions (B, S).
+
+    window > 0: sliding-window attention (attend iff 0 <= qpos-kpos < window).
+    chunk  > 0: chunked/local attention (attend iff same chunk, Llama4-style).
+    kv_valid: (B, S) bool — which cache slots hold real tokens.
+    """
+    qp = position_ids[:, :, None]
+    kp = kv_positions[:, None, :]
+    m = kp <= qp
+    if window > 0:
+        m &= (qp - kp) < window
+    if chunk > 0:
+        m &= (qp // chunk) == (kp // chunk)
+    if kv_valid is not None:
+        m &= kv_valid[:, None, :]
+    return m
+
+
+def prefill_causal_mask(seq_len: int, position_ids: jnp.ndarray,
+                        window: int = 0, chunk: int = 0) -> jnp.ndarray:
+    """Standard in-context causal mask for context encoding: query/key
+    positions are both ``position_ids`` (B, S) over the padded window."""
+    return causal_mask(position_ids, position_ids, None, window, chunk)
+
+
+def decode_mask(position_ids: jnp.ndarray, cache_len: int,
+                window: int = 0, chunk: int = 0) -> jnp.ndarray:
+    """Mask for token generation over a contiguous cache of length
+    ``cache_len`` whose slot i holds position i. position_ids: (B, T)."""
+    kv_pos = jnp.arange(cache_len, dtype=position_ids.dtype)[None, :]
+    kv_pos = jnp.broadcast_to(kv_pos, (position_ids.shape[0], cache_len))
+    return causal_mask(position_ids, kv_pos, None, window, chunk)
+
+
+def speculation_mask(position_ids: jnp.ndarray, cache_len: int,
+                     window: int = 0) -> jnp.ndarray:
+    """Mask for a block of k speculative tokens (B, k) against the cache —
+    same math as decode_mask; kept as a named entry point for parity with the
+    reference's speculation mask branch (model_base.py:259-306)."""
+    return decode_mask(position_ids, cache_len, window)

@@ -1,0 +1,34 @@
+"""Normalization ops (reference: modules/custom_calls.py ``CustomRMSNorm`` and
+the NKI rmsnorm_quant kernel, models/llama/modeling_llama.py:553-575).
+
+On TPU, RMSNorm is a plain fused elementwise reduction — XLA fuses it into the
+surrounding matmuls, so no custom call is needed. Computation is done in fp32
+and cast back (matches reference numerics: CustomRMSNorm upcasts)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-6,
+             offset: float = 0.0) -> jnp.ndarray:
+    """RMSNorm. ``offset`` = 1.0 gives the (1+w) Gemma variant."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    w = weight.astype(jnp.float32) + offset
+    return (xf * w).astype(dtype)
+
+
+def layer_norm(x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray,
+               eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    xf = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    out = xf * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)

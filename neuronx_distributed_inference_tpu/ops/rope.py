@@ -1,0 +1,105 @@
+"""Rotary position embeddings (reference: modules/attention/utils.py RoPE
+helpers + Llama3 scaled RoPE at models/llama/modeling_llama.py:805).
+
+TPU-first: cos/sin are computed on the fly from position_ids inside the traced
+graph (cheap VPU work, avoids an S×D table in HBM) in fp32 for accuracy.
+Supports: default RoPE, linear scaling, dynamic NTK, llama3 frequency scaling,
+and partial rotary (rotary_dim < head_dim)."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RopeConfig:
+    head_dim: int
+    rope_theta: float = 10000.0
+    rotary_dim: Optional[int] = None     # partial rotary support
+    scaling_type: Optional[str] = None   # None | "linear" | "llama3" | "yarn"
+    scaling_factor: float = 1.0
+    # llama3 scaling params (reference: modeling_llama.py:805 Llama3RotaryEmbedding)
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+    # yarn params (deepseek)
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    mscale: float = 1.0
+    mscale_all_dim: float = 0.0
+
+    @property
+    def dim(self) -> int:
+        return self.rotary_dim or self.head_dim
+
+
+def _base_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
+    d = cfg.dim
+    return 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+
+
+SUPPORTED_SCALING = (None, "default", "linear", "llama3")
+
+
+def compute_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
+    if cfg.scaling_type not in SUPPORTED_SCALING:
+        raise NotImplementedError(
+            f"rope scaling type {cfg.scaling_type!r} not implemented yet "
+            f"(supported: {SUPPORTED_SCALING})")
+    inv_freq = _base_inv_freq(cfg)
+    if cfg.scaling_type == "linear":
+        inv_freq = inv_freq / cfg.scaling_factor
+    elif cfg.scaling_type == "llama3":
+        # Llama-3.1 frequency-dependent scaling (reference: modeling_llama.py:805-840)
+        low_wavelen = cfg.original_max_position / cfg.low_freq_factor
+        high_wavelen = cfg.original_max_position / cfg.high_freq_factor
+        wavelen = 2 * math.pi / inv_freq
+        scaled = inv_freq / cfg.scaling_factor
+        smooth = (cfg.original_max_position / wavelen - cfg.low_freq_factor) / (
+            cfg.high_freq_factor - cfg.low_freq_factor)
+        mid = (1 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(wavelen < high_wavelen, inv_freq,
+                             jnp.where(wavelen > low_wavelen, scaled, mid))
+    return inv_freq
+
+
+def rope_cos_sin(position_ids: jnp.ndarray, cfg: RopeConfig
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, S) int positions -> cos/sin of shape (B, S, dim/2), fp32."""
+    inv_freq = compute_inv_freq(cfg)
+    angles = position_ids.astype(jnp.float32)[..., None] * inv_freq  # (B,S,d/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+               interleaved: bool = False) -> jnp.ndarray:
+    """Apply rotary embedding.
+
+    x: (B, S, H, D); cos/sin: (B, S, d/2) where d = rotary dim (may be < D).
+    Default is the HF "half" convention (rotate_half); ``interleaved`` selects
+    the GPT-NeoX interleaved pairing.
+    """
+    d2 = cos.shape[-1]
+    d = 2 * d2
+    dtype = x.dtype
+    x_rot, x_pass = x[..., :d], x[..., d:]
+    xf = x_rot.astype(jnp.float32)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    if interleaved:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        o1 = x1 * c - x2 * s
+        o2 = x2 * c + x1 * s
+        out = jnp.stack([o1, o2], axis=-1).reshape(xf.shape)
+    else:
+        x1, x2 = xf[..., :d2], xf[..., d2:]
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    out = out.astype(dtype)
+    if x_pass.shape[-1]:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out

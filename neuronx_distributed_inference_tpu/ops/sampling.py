@@ -1,0 +1,119 @@
+"""On-device sampling (reference: modules/generation/sampling.py ``Sampler``).
+
+Everything runs inside the decode graph: greedy argmax, or
+top-k / top-p / temperature multinomial with **per-request** sampling params
+(reference: prepare_sampling_params :183 — a (B, 3) tensor of
+[top_k, top_p, temperature]).
+
+The reference implements a multi-stage hierarchical top-k because Neuron lacks
+a fast full-vocab sort (:285-335). On TPU, ``jax.lax.top_k`` with a static
+``global_topk`` bound (default 256) plays the same role: one top_k over the
+vocab shard, then per-request masking down to the dynamic k.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import OnDeviceSamplingConfig
+
+
+def prepare_sampling_params(batch_size: int, top_k=1, top_p=1.0, temperature=1.0):
+    """Host helper -> (B, 3) fp32 [top_k, top_p, temperature]
+    (reference: sampling.py:183 ``prepare_sampling_params``)."""
+    import numpy as np
+
+    def _bcast(v):
+        a = np.asarray(v, dtype=np.float32).reshape(-1)
+        if a.size == 1:
+            a = np.full((batch_size,), a[0], dtype=np.float32)
+        if a.size != batch_size:
+            raise ValueError(f"sampling param batch {a.size} != {batch_size}")
+        return a
+
+    return np.stack([_bcast(top_k), _bcast(top_p), _bcast(temperature)], axis=1)
+
+
+def mask_padded_logits(logits: jnp.ndarray, pad_size: int) -> jnp.ndarray:
+    """Mask vocab-padding columns added for tp divisibility
+    (reference: sampling.py:24 ``mask_padded_logits``)."""
+    if pad_size == 0:
+        return logits
+    v = logits.shape[-1]
+    col = jnp.arange(v) >= (v - pad_size)
+    return jnp.where(col, jnp.finfo(logits.dtype).min, logits)
+
+
+def greedy_sample(logits: jnp.ndarray) -> jnp.ndarray:
+    """(…, V) -> (…,) int32 argmax (reference: nxd argmax op path)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def topk_topp_sample(logits: jnp.ndarray, sampling_params: jnp.ndarray,
+                     key: jax.Array, global_topk: int = 256,
+                     deterministic: bool = False) -> jnp.ndarray:
+    """Per-request top-k/top-p/temperature sampling.
+
+    logits (B, V); sampling_params (B, 3) = [top_k, top_p, temperature].
+    top_k <= 0 or >= global_topk means "no k truncation beyond global_topk".
+    """
+    b, v = logits.shape
+    k = min(global_topk, v)
+    lf = logits.astype(jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(lf, k)  # (B, k) sorted desc
+
+    req_k = sampling_params[:, 0]
+    req_p = sampling_params[:, 1]
+    temp = jnp.maximum(sampling_params[:, 2], 1e-6)
+
+    ranks = jnp.arange(k, dtype=jnp.float32)[None, :]
+    kmask = jnp.where(req_k[:, None] > 0, ranks < req_k[:, None], True)
+
+    scaled = top_vals / temp[:, None]
+    probs = jax.nn.softmax(jnp.where(kmask, scaled, -jnp.inf), axis=-1)
+    # top-p: keep the smallest prefix of sorted probs with cumsum >= p,
+    # always keeping the top token.
+    cum = jnp.cumsum(probs, axis=-1)
+    pmask = (cum - probs) < req_p[:, None]
+    probs = jnp.where(pmask & kmask, probs, 0.0)
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+
+    if deterministic:
+        choice = jnp.argmax(probs, axis=-1)
+    else:
+        # gumbel-max over the truncated distribution
+        g = jax.random.gumbel(key, probs.shape, dtype=jnp.float32)
+        choice = jnp.argmax(jnp.where(probs > 0, jnp.log(probs) + g, -jnp.inf), axis=-1)
+    return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def sample(logits: jnp.ndarray, config: Optional[OnDeviceSamplingConfig],
+           sampling_params: Optional[jnp.ndarray] = None,
+           key: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Dispatch greedy vs multinomial; (B, V) or (B, T, V) logits -> tokens."""
+    squeeze = False
+    if logits.ndim == 3:
+        b, t, v = logits.shape
+        logits = logits.reshape(b * t, v)
+        squeeze = (b, t)
+    if sampling_params is None and (config is None or not config.do_sample):
+        toks = greedy_sample(logits)
+    elif sampling_params is None:
+        sp = jnp.broadcast_to(
+            jnp.array([[config.top_k, config.top_p, config.temperature]],
+                      jnp.float32), (logits.shape[0], 3))
+        toks = topk_topp_sample(logits, sp, key, config.global_topk,
+                                config.deterministic)
+    else:
+        if sampling_params.shape[0] != logits.shape[0]:
+            sampling_params = jnp.repeat(
+                sampling_params, logits.shape[0] // sampling_params.shape[0], axis=0)
+        toks = topk_topp_sample(logits, sampling_params, key,
+                                config.global_topk if config else 256,
+                                config.deterministic if config else False)
+    if squeeze:
+        toks = toks.reshape(squeeze)
+    return toks

@@ -1,0 +1,158 @@
+"""Device mesh construction — the TPU-native replacement for the reference's
+process-group zoo (reference: neuronx_distributed ``parallel_state``
+``initialize_model_parallel`` and
+src/neuronx_distributed_inference/modules/attention/attention_process_groups.py).
+
+Instead of materializing TP/CP/DP/EP process groups, we build ONE
+``jax.sharding.Mesh`` with named axes and express each parallelism strategy as
+a PartitionSpec over those axes:
+
+  axis "dp" — attention data parallel (decode batch sharding,
+              reference: attention_process_groups.py:125-163)
+  axis "cp" — context parallel (prefill sequence sharding,
+              reference: attention_process_groups.py:81-123)
+  axis "tp" — tensor parallel (heads / hidden sharding)
+  axis "ep" — expert parallel (MoE expert sharding, reference: modules/moe_v2.py:135-161)
+
+The reference's phase asymmetry (CP groups for prefill, DP groups for decode
+over the SAME ranks — attention_base.py:183-199) maps here to *reusing* the
+``cp`` axis: during prefill activations shard sequence over ("dp","cp"), during
+decode the batch shards over ("dp","cp"). The mesh itself never changes, only
+the PartitionSpecs, so no KV-head reshuffling between phases is required when
+layouts are chosen consistently.
+
+Multi-host: ``jax.distributed.initialize`` over DCN replaces the reference's
+MPI + NEURON_RT_ROOT_COMM_ID bootstrap
+(reference: scripts/nxdi_distributed_launcher.py:29-85).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger("nxdi_tpu")
+
+# Canonical axis order: outermost (slowest-varying, DCN-friendly) first.
+AXIS_DP = "dp"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+MESH_AXES = (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_EP)
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    tp: int = 1
+    cp: int = 1
+    dp: int = 1
+    ep: int = 1
+
+    @property
+    def world_size(self) -> int:
+        # cp and dp shard the tp device set during different phases; ep reuses
+        # tp devices for MoE. The physical world is dp*cp*tp with ep folded
+        # into tp (moe_tp x moe_ep = tp, reference: modules/moe_v2.py:135-161).
+        return self.dp * self.cp * self.tp
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bootstrap over DCN. Safe no-op for single-process runs.
+
+    Replaces the reference's MPI launcher + gloo host barrier
+    (reference: inference_demo.py:788-796, scripts/nxdi_distributed_launcher.py).
+    """
+    if num_processes is None:
+        num_processes = int(os.environ.get("NXDI_TPU_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the (dp, cp, tp, ep) mesh.
+
+    ep=1 devices-wise: expert parallelism reuses tp-axis devices via a derived
+    mesh (see :func:`moe_mesh_axes`); only dp*cp*tp physical devices are laid
+    out here. Device order follows jax.devices() which is ICI-contiguous —
+    tp innermost so tp collectives ride the fastest links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = cfg.dp * cfg.cp * cfg.tp
+    if len(devices) < n:
+        raise ValueError(f"mesh needs {n} devices (dp={cfg.dp} cp={cfg.cp} "
+                         f"tp={cfg.tp}), only {len(devices)} available")
+    dev_array = np.array(devices[:n]).reshape(cfg.dp, cfg.cp, cfg.tp, 1)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return build_mesh(MeshConfig())
+
+
+def mesh_from_config(tpu_config) -> Mesh:
+    """Build mesh from a TpuConfig's parallelism degrees."""
+    # attention-DP and CP both subdivide the tp rank set in the reference
+    # (tp_degree counts ALL ranks; cp/dp are groupings of them:
+    # attention_process_groups.py:36-163). Here tp axis = tp/(cp*dp), so the
+    # physical world stays tp_degree devices.
+    cp = max(tpu_config.cp_degree, 1)
+    dp = max(tpu_config.attention_dp_degree, 1)
+    shrink = cp * dp
+    if tpu_config.tp_degree % shrink != 0:
+        raise ValueError(f"tp_degree {tpu_config.tp_degree} not divisible by "
+                         f"cp_degree*attention_dp_degree = {shrink}")
+    return build_mesh(MeshConfig(tp=tpu_config.tp_degree // shrink, cp=cp, dp=dp,
+                                 ep=max(tpu_config.ep_degree, 1)))
+
+
+# ---------------------------------------------------------------------------
+# Sharding helpers
+# ---------------------------------------------------------------------------
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_spec(mesh: Mesh) -> P:
+    """Decode-phase batch sharding over dp (and cp when cp>1 is repurposed,
+    reference: DataParallelKVCacheManager)."""
+    axes = [a for a, s in zip(mesh.axis_names, mesh.devices.shape) if s > 1
+            and a in (AXIS_DP, AXIS_CP)]
+    return P(tuple(axes) if axes else None)
+
+
+def logical_to_physical(rules: dict, logical_axes: Tuple[Optional[str], ...]) -> P:
+    """Map logical axis names (e.g. ("batch", "seq", "hidden")) to mesh axes."""
+    return P(*[rules.get(a) if a is not None else None for a in logical_axes])
+
+
+# Default logical->mesh rules for decoder LLMs.
+DEFAULT_RULES = {
+    "batch": AXIS_DP,
+    "seq": None,            # sequence sharded only under SP/CP via explicit specs
+    "hidden": None,
+    "heads": AXIS_TP,
+    "kv_heads": AXIS_TP,
+    "mlp": AXIS_TP,
+    "vocab": AXIS_TP,
+    "expert": AXIS_EP,
+    "layer": None,
+}

@@ -1,0 +1,122 @@
+"""Checkpoint I/O (reference: modules/checkpoint.py).
+
+Loads HF checkpoints (safetensors — single or sharded via index json — or
+torch .bin) into host numpy dicts; model families provide
+``convert_hf_state_dict`` to reshape into the stacked/padded TPU layout; this
+module then device_puts each leaf with its NamedSharding (shard-on-load —
+the analog of the reference's ``builder.shard_checkpoint``,
+application_base.py:375-421)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+logger = logging.getLogger("nxdi_tpu")
+
+SAFETENSORS_INDEX = "model.safetensors.index.json"
+
+
+def load_state_dict(model_path: str) -> Dict[str, np.ndarray]:
+    """Load a HF checkpoint directory into {name: np.ndarray}
+    (reference: modules/checkpoint.py:24-170 ``load_state_dict`` — regular /
+    sharded safetensors and .bin paths)."""
+    if os.path.isfile(model_path):
+        return _load_one(model_path)
+    idx = os.path.join(model_path, SAFETENSORS_INDEX)
+    if os.path.exists(idx):
+        with open(idx) as f:
+            index = json.load(f)
+        shards = sorted(set(index["weight_map"].values()))
+        out: Dict[str, np.ndarray] = {}
+        for shard in shards:
+            out.update(_load_one(os.path.join(model_path, shard)))
+        return out
+    st = os.path.join(model_path, "model.safetensors")
+    if os.path.exists(st):
+        return _load_one(st)
+    bins = [f for f in os.listdir(model_path)
+            if f.endswith(".bin") and "training" not in f]
+    if bins:
+        out = {}
+        for b in sorted(bins):
+            out.update(_load_one(os.path.join(model_path, b)))
+        return out
+    raise FileNotFoundError(f"no checkpoint files found under {model_path}")
+
+
+def _load_one(path: str) -> Dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        from safetensors import safe_open
+        out = {}
+        with safe_open(path, framework="np") as f:
+            for k in f.keys():
+                try:
+                    out[k] = f.get_tensor(k)
+                except (TypeError, ValueError):
+                    # bf16 tensors: numpy lacks bfloat16 — round-trip via torch
+                    out[k] = _torch_tensor(path, k)
+        return out
+    import torch
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _to_numpy(v) for k, v in sd.items()}
+
+
+def _torch_tensor(path: str, key: str) -> np.ndarray:
+    from safetensors import safe_open
+    with safe_open(path, framework="pt") as f:
+        return _to_numpy(f.get_tensor(key))
+
+
+def _to_numpy(t) -> np.ndarray:
+    import torch
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def save_state_dict_safetensors(state_dict: Dict[str, np.ndarray], path: str,
+                                max_shard_bytes: int = 5 * 2**30):
+    """Save as (possibly sharded) safetensors
+    (reference: modules/checkpoint.py ``save_state_dict_safetensors``)."""
+    from safetensors.numpy import save_file
+    os.makedirs(path, exist_ok=True)
+    items = list(state_dict.items())
+    shards, cur, cur_bytes = [], {}, 0
+    for k, v in items:
+        if cur and cur_bytes + v.nbytes > max_shard_bytes:
+            shards.append(cur)
+            cur, cur_bytes = {}, 0
+        cur[k] = v
+        cur_bytes += v.nbytes
+    shards.append(cur)
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(path, "model.safetensors"))
+        return
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+        save_file(shard, os.path.join(path, name))
+        for k in shard:
+            weight_map[k] = name
+    with open(os.path.join(path, SAFETENSORS_INDEX), "w") as f:
+        json.dump({"metadata": {}, "weight_map": weight_map}, f)
+
+
+def device_put_params(host_params: Dict[str, Any], shardings: Dict[str, Any],
+                      dtype=None) -> Dict[str, Any]:
+    """Transfer a host param tree to devices with per-leaf shardings."""
+
+    def _put(x, s):
+        if dtype is not None and np.issubdtype(np.asarray(x).dtype, np.floating):
+            x = np.asarray(x).astype(dtype)
+        return jax.device_put(x, s)
+
+    return jax.tree.map(_put, host_params, shardings)

@@ -1,0 +1,64 @@
+"""Test env: force a virtual 8-device CPU mesh so sharding/collective logic is
+exercised without TPU hardware (reference analog: NXD_CPU_MODE + gloo fake
+distributed backend, utils/testing.py:40-64)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override the session's axon/TPU default
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax may already be imported by the interpreter's sitecustomize with the TPU
+# plugin registered; config.update still wins as long as no backend has been
+# initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_threefry_partitionable", True)
+# fp32 tests compare against torch exactly; don't let matmuls drop precision
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def tiny_llama_hf_config(**over):
+    """4-layer random-weight tiny config (reference test strategy:
+    test/integration tiny models with num_hidden_layers=4, SURVEY §4)."""
+    cfg = dict(
+        model_type="llama",
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=512,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        hidden_act="silu",
+        tie_word_embeddings=False,
+        torch_dtype="float32",
+    )
+    cfg.update(over)
+    return cfg
+
+
+@pytest.fixture
+def tiny_config_dict():
+    return tiny_llama_hf_config()

@@ -1,0 +1,56 @@
+"""Config system tests (reference analog: test/unit config tests)."""
+
+import json
+
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    InferenceConfig, OnDeviceSamplingConfig, SpeculationConfig, TpuConfig)
+
+
+def test_defaults_derive():
+    c = TpuConfig(batch_size=2, seq_len=256)
+    assert c.max_batch_size == 2
+    assert c.ctx_batch_size == 2
+    assert c.tkg_batch_size == 2
+    assert c.kv_cache_batch_size == 2
+    assert c.max_context_length == 256
+    assert c.kv_cache_dtype == "bfloat16"
+
+
+def test_continuous_batching_ctx_batch():
+    c = TpuConfig(batch_size=4, is_continuous_batching=True)
+    assert c.ctx_batch_size == 1
+    assert c.kv_cache_batch_size == 4
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        TpuConfig(seq_len=128, max_context_length=256)
+    with pytest.raises(ValueError):
+        TpuConfig(tp_degree=8, cp_degree=3)
+    with pytest.raises(ValueError):
+        TpuConfig(is_chunked_prefill=True)
+
+
+def test_json_round_trip(tmp_path):
+    c = TpuConfig(batch_size=2, seq_len=128, tp_degree=4,
+                  on_device_sampling_config=OnDeviceSamplingConfig(
+                      do_sample=True, top_k=50),
+                  speculation_config=SpeculationConfig(
+                      speculation_length=5, enable_fused_speculation=True))
+    cfg = InferenceConfig(c, hidden_size=64, num_attention_heads=4,
+                          vocab_size=512)
+    p = tmp_path / "cfg.json"
+    cfg.save(str(p))
+    loaded = InferenceConfig.load(str(p))
+    assert loaded.tpu_config.batch_size == 2
+    assert loaded.tpu_config.tp_degree == 4
+    assert loaded.tpu_config.on_device_sampling_config.top_k == 50
+    assert loaded.tpu_config.speculation_config.speculation_length == 5
+    assert loaded.hidden_size == 64
+
+
+def test_unknown_keys_warn_not_raise():
+    c = TpuConfig.from_dict({"batch_size": 1, "definitely_not_a_knob": 7})
+    assert c.batch_size == 1

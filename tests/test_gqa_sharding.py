@@ -1,0 +1,70 @@
+"""GQA head padding/replication math (reference analog: test/unit gqa tests
+for gqa.py:32-244 semantics)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.parallel.layers import (
+    place_q_weight, replicate_kv_weight, resolve_gqa_sharding)
+
+
+def test_identity_when_divisible():
+    g = resolve_gqa_sharding(32, 8, 8)
+    assert g.is_identity
+    assert g.q_per_kv == 4 and g.kv_replication == 1
+
+
+def test_replicate_to_tp_degree():
+    # llama-8B at tp=32: 8 kv heads -> replicated 4x
+    g = resolve_gqa_sharding(32, 8, 32)
+    assert g.num_kv_heads == 32 and g.kv_replication == 4
+    assert g.num_q_heads == 32
+    assert g.q_slot_map == tuple(range(32))  # identity permutation here
+
+
+def test_over_replication_permutes_q():
+    # tiny: 4 q / 2 kv at tp=8 -> kv replicated 4x, q heads spread out
+    g = resolve_gqa_sharding(4, 2, 8)
+    assert g.num_kv_heads == 8 and g.kv_replication == 4
+    assert g.num_q_heads == 8 and g.q_per_kv == 1
+    assert g.q_slot_map == (0, 1, 4, 5)
+    # check alignment: q slot s attends kv slot s//g.q_per_kv which must hold
+    # the original kv head of the original q head placed at s
+    for i, s in enumerate(g.q_slot_map):
+        orig_kv = i // (g.orig_q_heads // g.orig_kv_heads)
+        padded_kv_slot = s // g.q_per_kv
+        assert padded_kv_slot // g.kv_replication == orig_kv
+
+
+def test_kv_weight_replication_layout():
+    g = resolve_gqa_sharding(4, 2, 8)
+    d = 4
+    w = np.arange(2 * 2 * d, dtype=np.float32).reshape(2, 2 * d)  # (H=2, kv*D)
+    out = replicate_kv_weight(w, g, d, axis=-1)
+    assert out.shape == (2, 8 * d)
+    heads = out.reshape(2, 8, d)
+    orig = w.reshape(2, 2, d)
+    for s in range(8):
+        np.testing.assert_array_equal(heads[:, s], orig[:, s // 4])
+
+
+def test_q_weight_placement_zero_fills():
+    g = resolve_gqa_sharding(4, 2, 8)
+    d = 4
+    w = np.arange(2 * 4 * d, dtype=np.float32).reshape(2, 4 * d) + 1
+    out = place_q_weight(w, g, d, axis=-1)
+    heads = out.reshape(2, 8, d)
+    orig = w.reshape(2, 4, d)
+    for i, s in enumerate(g.q_slot_map):
+        np.testing.assert_array_equal(heads[:, s], orig[:, i])
+    used = set(g.q_slot_map)
+    for s in range(8):
+        if s not in used:
+            assert (heads[:, s] == 0).all()
+
+
+def test_unsupported_combo_raises():
+    with pytest.raises(ValueError):
+        resolve_gqa_sharding(30, 7, 8)
+    with pytest.raises(ValueError):
+        resolve_gqa_sharding(32, 6, 8)

@@ -1,0 +1,66 @@
+"""KV cache manager tests (reference analog: test/unit kv cache tests)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules import kv_cache as kv
+
+
+def _spec(**over):
+    d = dict(num_layers=2, batch_size=4, max_seq_len=16, num_kv_heads=2,
+             head_dim=8, dtype=jnp.float32)
+    d.update(over)
+    return kv.KVCacheSpec(**d)
+
+
+def test_init_shape():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    assert c["k"].shape == (2, 4, 16, 2, 8)
+    assert c["v"].dtype == jnp.float32
+
+
+def test_prefill_write_rows():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.ones((2, 5, 2, 8))
+    out = kv.write_prefill(c["k"][0], new, jnp.asarray([2, 0]))
+    out = np.asarray(out)
+    assert (out[2, :5] == 1).all() and (out[0, :5] == 1).all()
+    assert (out[2, 5:] == 0).all()
+    assert (out[1] == 0).all() and (out[3] == 0).all()
+
+
+def test_decode_scatter_positions():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.full((2, 1, 2, 8), 7.0)
+    out = kv.write_tokens(c["k"][0], new, jnp.asarray([1, 3]),
+                          jnp.asarray([[4], [9]]))
+    out = np.asarray(out)
+    assert (out[1, 4] == 7).all() and (out[3, 9] == 7).all()
+    assert out.sum() == 7 * 2 * 2 * 8
+
+
+def test_decode_write_out_of_range_dropped():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.full((1, 1, 2, 8), 3.0)
+    out = kv.write_tokens(c["k"][0], new, jnp.asarray([0]), jnp.asarray([[99]]))
+    assert np.asarray(out).sum() == 0
+
+
+def test_rolling_window_write():
+    spec = _spec(window=8)
+    assert spec.cache_len == 8
+    c = kv.init_cache(spec)
+    new = jnp.full((1, 1, 2, 8), 2.0)
+    out = kv.write_tokens(c["k"][0], new, jnp.asarray([0]),
+                          jnp.asarray([[11]]), window=8)
+    assert (np.asarray(out)[0, 3] == 2).all()  # 11 % 8
+
+
+def test_fp8_quantize_cast():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 3)), jnp.float32)
+    q = kv.quantize_kv(x, jnp.float8_e4m3fn)
+    assert q.dtype == jnp.float8_e4m3fn
