@@ -1,0 +1,190 @@
+"""inference_demo CLI (reference: inference_demo.py — argparse mirror of the
+config system :99-408, run flow :493-680).
+
+Subcommand ``run`` compiles + loads a model, generates from prompts, and
+optionally runs the accuracy gates and benchmark, mirroring the reference's
+``inference_demo --model-type llama --task-type causal-lm run ...``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="inference_demo_tpu")
+    p.add_argument("--model-type", default=None,
+                   help="model family (llama/mistral/qwen2/qwen3/...); "
+                        "default: read from config.json")
+    p.add_argument("--task-type", default="causal-lm")
+    sub = p.add_subparsers(dest="command", required=True)
+    run = sub.add_parser("run", help="compile, load, generate")
+    run.add_argument("--model-path", required=True)
+    run.add_argument("--compiled-model-path", default=None)
+    run.add_argument("--prompt", action="append", default=None)
+    run.add_argument("--prompt-len", type=int, default=16,
+                     help="random-token prompt length when no --prompt given "
+                          "or no tokenizer available")
+    run.add_argument("--tp-degree", type=int, default=1)
+    run.add_argument("--cp-degree", type=int, default=1)
+    run.add_argument("--batch-size", type=int, default=1)
+    run.add_argument("--max-context-length", type=int, default=128)
+    run.add_argument("--seq-len", type=int, default=256)
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=["bfloat16", "float32", "float16"])
+    run.add_argument("--max-new-tokens", type=int, default=64)
+    run.add_argument("--random-weights", action="store_true",
+                     help="skip checkpoint load; synthetic weights")
+    run.add_argument("--on-cpu", action="store_true",
+                     help="run on virtual CPU devices (reference --on-cpu)")
+    run.add_argument("--enable-bucketing", action="store_true", default=True)
+    run.add_argument("--no-bucketing", dest="enable_bucketing",
+                     action="store_false")
+    run.add_argument("--decode-chunk-tokens", type=int, default=1)
+    # sampling
+    run.add_argument("--on-device-sampling", action="store_true")
+    run.add_argument("--do-sample", action="store_true")
+    run.add_argument("--top-k", type=int, default=1)
+    run.add_argument("--top-p", type=float, default=1.0)
+    run.add_argument("--temperature", type=float, default=1.0)
+    # accuracy (reference: --check-accuracy-mode)
+    run.add_argument("--check-accuracy-mode", default="skip-accuracy-check",
+                     choices=["skip-accuracy-check", "token-matching",
+                              "logit-matching"])
+    run.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    run.add_argument("--num-tokens-to-check", type=int, default=16)
+    # benchmark (reference: --benchmark)
+    run.add_argument("--benchmark", action="store_true")
+    run.add_argument("--benchmark-runs", type=int, default=5)
+    run.add_argument("--benchmark-report-path",
+                     default="benchmark_report.json")
+    run.add_argument("--seed", type=int, default=0)
+    return p
+
+
+def _force_cpu(n: int = 8):
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", n)
+    except RuntimeError:
+        pass
+
+
+def run_inference(args) -> int:
+    if args.on_cpu:
+        _force_cpu(max(args.tp_degree, 8))
+    from .config import (InferenceConfig, OnDeviceSamplingConfig, TpuConfig,
+                         load_pretrained_config)
+    from .models.application import CausalLMApplication
+    from .models.family import get_family
+
+    sampling_cfg = None
+    if args.on_device_sampling or args.do_sample:
+        sampling_cfg = OnDeviceSamplingConfig(
+            do_sample=args.do_sample, top_k=args.top_k, top_p=args.top_p,
+            temperature=args.temperature)
+    tcfg = TpuConfig(
+        batch_size=args.batch_size, seq_len=args.seq_len,
+        max_context_length=args.max_context_length, dtype=args.dtype,
+        tp_degree=args.tp_degree, cp_degree=args.cp_degree,
+        enable_bucketing=args.enable_bucketing,
+        decode_chunk_tokens=args.decode_chunk_tokens,
+        on_device_sampling_config=sampling_cfg,
+        output_logits=args.check_accuracy_mode == "logit-matching",
+        compile_cache_dir=args.compiled_model_path, seed=args.seed)
+
+    # model family from config.json unless overridden
+    with open(os.path.join(args.model_path, "config.json")) as f:
+        model_type = args.model_type or json.load(f).get("model_type")
+    family = get_family(model_type)
+    icfg = family.config_cls(tcfg,
+                             load_config=load_pretrained_config(args.model_path))
+    app = CausalLMApplication(args.model_path, icfg, family)
+    if args.random_weights:
+        app.init_random_weights(args.seed)
+    else:
+        app.load_weights()
+    app.init_cache()
+    if args.compiled_model_path:
+        app.compile(args.compiled_model_path)
+
+    # build input ids: tokenizer if available, else random tokens
+    tokenizer = None
+    eos = None
+    try:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(args.model_path)
+        eos = tokenizer.eos_token_id
+    except Exception:
+        logger.info("no tokenizer found; using random token prompts")
+    if args.prompt and tokenizer is not None:
+        prompts = args.prompt * args.batch_size
+        enc = tokenizer(prompts[:args.batch_size], return_tensors="np",
+                        padding=True, padding_side="right")
+        input_ids = enc["input_ids"].astype(np.int32)
+        attention_mask = enc["attention_mask"].astype(np.int32)
+    else:
+        rng = np.random.default_rng(args.seed)
+        input_ids = rng.integers(
+            1, icfg.vocab_size, size=(args.batch_size, args.prompt_len),
+            dtype=np.int32)
+        attention_mask = np.ones_like(input_ids)
+
+    res = app.generate(input_ids, attention_mask=attention_mask,
+                       max_new_tokens=args.max_new_tokens, eos_token_id=eos)
+    print(f"TTFT: {res['ttft_s'] * 1e3:.1f} ms")
+    for i, row in enumerate(res["sequences"]):
+        if tokenizer is not None:
+            print(f"--- output {i} ---")
+            print(tokenizer.decode(row, skip_special_tokens=True))
+        else:
+            print(f"--- output {i} --- {row.tolist()}")
+
+    rc = 0
+    if args.check_accuracy_mode != "skip-accuracy-check":
+        from .utils import accuracy
+        hf_model = family.load_hf_model(args.model_path)
+        app.reset()
+        if args.check_accuracy_mode == "token-matching":
+            rep = accuracy.check_accuracy(
+                app, hf_model, input_ids, attention_mask=attention_mask,
+                max_new_tokens=args.num_tokens_to_check, eos_token_id=eos)
+        else:
+            rep = accuracy.check_accuracy_logits(
+                app, hf_model, input_ids, attention_mask=attention_mask,
+                max_new_tokens=args.num_tokens_to_check,
+                divergence_difference_tol=args.divergence_difference_tol)
+        print(rep)
+        rc = 0 if rep.passed else 1
+
+    if args.benchmark:
+        from .utils.benchmark import benchmark_sampling
+        app.reset()
+        report = benchmark_sampling(app, input_ids,
+                                    max_new_tokens=args.max_new_tokens,
+                                    n_runs=args.benchmark_runs,
+                                    report_path=args.benchmark_report_path)
+        print(json.dumps(report, indent=2))
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    args = build_parser().parse_args(argv)
+    if args.command == "run":
+        return run_inference(args)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,7 @@
 """Model hub (reference: models/ — SURVEY §2.7)."""
 
 from . import family  # noqa: F401
-from .llama import modeling_llama  # noqa: F401  (registers "llama")
+from .llama import modeling_llama  # noqa: F401
+from .mistral import modeling_mistral  # noqa: F401
+from .qwen2 import modeling_qwen2  # noqa: F401
+from .qwen3 import modeling_qwen3  # noqa: F401

@@ -238,10 +238,15 @@ class CausalLMApplication:
                  max_new_tokens: int = 128,
                  eos_token_id: Optional[int] = None,
                  sampling_params: Optional[np.ndarray] = None,
-                 return_logits: bool = False) -> Dict[str, Any]:
+                 return_logits: bool = False,
+                 teacher_tokens: Optional[np.ndarray] = None) -> Dict[str, Any]:
         """Greedy/sampled generation. input_ids (B, S) right-padded;
         attention_mask (B, S) marks real tokens. Returns sequences including
-        the prompt (HF convention)."""
+        the prompt (HF convention).
+
+        teacher_tokens (B, T): teacher-forcing for logit-matching accuracy —
+        feed these instead of the sampled tokens (reference:
+        utils/accuracy.py logit flow re-feeds golden tokens)."""
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
         if attention_mask is None:
@@ -254,6 +259,10 @@ class CausalLMApplication:
         if sampling_params is not None:
             sampling_params = jnp.asarray(sampling_params)
 
+        if teacher_tokens is not None:
+            # teacher forcing can feed at most T tokens, producing T+1 steps
+            max_new_tokens = min(max_new_tokens,
+                                 np.asarray(teacher_tokens).shape[1] + 1)
         bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
         padded = np.zeros((b, bucket), input_ids.dtype)
         padded[:, :s] = input_ids
@@ -283,6 +292,10 @@ class CausalLMApplication:
             # the (already-compiled) single-step graph instead
             n = chunk if remaining >= chunk else 1
             cur = collected[-1][:, -1]
+            if teacher_tokens is not None:
+                cur = np.asarray(teacher_tokens[:, n_generated - 1],
+                                 dtype=np.int32)
+                n = 1
             if n == 1 or return_logits:
                 o = self._run_decode(cur[:, None], positions[:, None],
                                      sampling_params=sampling_params)

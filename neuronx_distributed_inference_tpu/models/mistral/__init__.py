@@ -1,0 +1,2 @@
+"""mistral family."""
+from .modeling_mistral import *  # noqa: F401,F403

@@ -1,0 +1,28 @@
+"""Mistral family (reference: models/mistral/modeling_mistral.py
+``NeuronMistralForCausalLM``). Llama-shaped with optional sliding-window
+attention (Mistral-7B-v0.1 window=4096)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+
+
+class MistralInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size"]
+
+
+@register_family("mistral")
+class MistralFamily(DecoderFamily):
+    config_cls = MistralInferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        window = getattr(config, "sliding_window", None) or 0
+        return spec_from_config(config, tp_degree, sliding_window=int(window))

@@ -1,0 +1,2 @@
+"""qwen2 family."""
+from .modeling_qwen2 import *  # noqa: F401,F403

@@ -1,0 +1,31 @@
+"""Qwen2 family (reference: models/qwen2/modeling_qwen2.py
+``NeuronQwen2ForCausalLM``). Llama-shaped with QKV projection biases."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+
+
+class Qwen2InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size"]
+
+
+@register_family("qwen2")
+class Qwen2Family(DecoderFamily):
+    config_cls = Qwen2InferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        # sliding window exists in the HF config but is disabled by default
+        window = 0
+        if getattr(config, "use_sliding_window", False):
+            window = getattr(config, "sliding_window", None) or 0
+        return spec_from_config(config, tp_degree, qkv_bias=True,
+                                sliding_window=int(window))

@@ -1,0 +1,2 @@
+"""qwen3 family."""
+from .modeling_qwen3 import *  # noqa: F401,F403

@@ -1,0 +1,100 @@
+"""Benchmark harness (reference: utils/benchmark.py — ``LatencyCollector``
+forward hooks :397-431, ``Benchmark`` loop :449-482, report schema :496-516,
+``benchmark_sampling`` :21-208).
+
+Same report schema: latency_ms_{p0,p50,p90,p95,p99,p100,avg} per submodel and
+e2e, plus throughput = total generated tokens / total time."""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+BENCHMARK_REPORT_FILENAME = "benchmark_report.json"
+
+
+class LatencyCollector:
+    """Accumulates per-call wall times for one submodel tag
+    (reference: utils/benchmark.py:397-431)."""
+
+    def __init__(self):
+        self.latency_list: List[float] = []
+
+    def record(self, seconds: float):
+        self.latency_list.append(seconds)
+
+    def percentile(self, pct: float) -> float:
+        if not self.latency_list:
+            return 0.0
+        return float(np.percentile(self.latency_list, pct))
+
+    def report(self) -> Dict[str, float]:
+        out = {}
+        for pct in (0, 50, 90, 95, 99, 100):
+            out[f"latency_ms_p{pct}"] = self.percentile(pct) * 1e3
+        out["latency_ms_avg"] = (float(np.mean(self.latency_list)) * 1e3
+                                 if self.latency_list else 0.0)
+        return out
+
+
+class Benchmark:
+    """E2E benchmark loop (reference: utils/benchmark.py:449-482)."""
+
+    def __init__(self, benchmark_func: Callable[[], Any], n_runs: int = 20,
+                 preprocess_func: Optional[Callable[[], Any]] = None):
+        self.benchmark_func = benchmark_func
+        self.n_runs = n_runs
+        self.preprocess_func = preprocess_func
+        self.collector = LatencyCollector()
+
+    def run(self):
+        for _ in range(self.n_runs):
+            if self.preprocess_func:
+                self.preprocess_func()
+            t0 = time.perf_counter()
+            self.benchmark_func()
+            self.collector.record(time.perf_counter() - t0)
+        return self.collector.report()
+
+
+def generate_report(e2e: LatencyCollector,
+                    submodel_collectors: Dict[str, LatencyCollector],
+                    total_generated_tokens: int,
+                    report_path: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble the reference-schema report
+    (reference: utils/benchmark.py:496-516 + JSON write :203-208)."""
+    total_time = sum(e2e.latency_list)
+    report: Dict[str, Any] = {"e2e_model": e2e.report()}
+    report["e2e_model"]["throughput"] = (
+        total_generated_tokens / total_time if total_time else 0.0)
+    for tag, col in submodel_collectors.items():
+        report[tag] = col.report()
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    return report
+
+
+def benchmark_sampling(app, input_ids: np.ndarray, max_new_tokens: int = 64,
+                       n_runs: int = 20,
+                       report_path: Optional[str] = None) -> Dict[str, Any]:
+    """Benchmark an application's generate() (reference:
+    utils/benchmark.py:21-208 ``benchmark_sampling``). One warmup run, then
+    n_runs timed runs; throughput counts generated tokens only."""
+    app.generate(input_ids, max_new_tokens=max_new_tokens)  # warmup/compile
+    e2e = LatencyCollector()
+    ttft = LatencyCollector()
+    total_tokens = 0
+    for _ in range(n_runs):
+        app.reset()
+        t0 = time.perf_counter()
+        res = app.generate(input_ids, max_new_tokens=max_new_tokens)
+        e2e.record(time.perf_counter() - t0)
+        ttft.record(res["ttft_s"])
+        total_tokens += int(res["generated"].size)
+    return generate_report(e2e, {"context_encoding_model": ttft},
+                           total_tokens, report_path)

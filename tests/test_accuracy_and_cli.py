@@ -1,0 +1,129 @@
+"""Accuracy-gate harness + inference_demo CLI tests
+(reference analog: utils/accuracy.py flows + inference_demo run)."""
+
+import json
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (LlamaFamily,
+                                                            LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.utils import accuracy
+
+from conftest import tiny_llama_hf_config
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(1)
+    m = LlamaForCausalLM(LlamaConfig(**tiny_llama_hf_config()))
+    m.eval()
+    d = tmp_path_factory.mktemp("tiny")
+    m.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _app(hf_dir, **over):
+    kw = dict(batch_size=2, seq_len=64, dtype="float32", output_logits=True,
+              enable_bucketing=False)
+    kw.update(over)
+    icfg = LlamaInferenceConfig(TpuConfig(**kw),
+                                load_config=load_pretrained_config(hf_dir))
+    return CausalLMApplication(hf_dir, icfg, LlamaFamily).load_weights().init_cache()
+
+
+def test_token_matching_gate(hf_dir):
+    app = _app(hf_dir)
+    hf = LlamaFamily.load_hf_model(hf_dir)
+    ids = np.random.default_rng(0).integers(1, 512, size=(2, 8), dtype=np.int64)
+    rep = accuracy.check_accuracy(app, hf, ids, max_new_tokens=12)
+    assert rep.passed, rep
+
+
+def test_logit_matching_gate(hf_dir):
+    app = _app(hf_dir)
+    hf = LlamaFamily.load_hf_model(hf_dir)
+    ids = np.random.default_rng(1).integers(1, 512, size=(2, 8), dtype=np.int64)
+    rep = accuracy.check_accuracy_logits(app, hf, ids, max_new_tokens=8,
+                                         divergence_difference_tol=0.005)
+    assert rep.passed, rep
+    assert rep.max_error < 0.005
+
+
+def test_logit_matching_detects_corruption(hf_dir):
+    """The gate must FAIL when the model is actually different."""
+    app = _app(hf_dir)
+    # corrupt lm_head
+    import jax.numpy as jnp
+    app.params["lm_head"] = app.params["lm_head"] + 0.05
+    hf = LlamaFamily.load_hf_model(hf_dir)
+    ids = np.random.default_rng(2).integers(1, 512, size=(2, 8), dtype=np.int64)
+    rep = accuracy.check_accuracy_logits(app, hf, ids, max_new_tokens=4)
+    assert not rep.passed
+
+
+def test_token_matching_ragged_batch(hf_dir):
+    """Rows of different lengths right-padded — the golden must be computed
+    per row (HF generate() chokes on right padding when batched)."""
+    app = _app(hf_dir, output_logits=False)
+    hf = LlamaFamily.load_hf_model(hf_dir)
+    rng = np.random.default_rng(5)
+    ids = np.zeros((2, 10), np.int64)
+    mask = np.zeros((2, 10), np.int64)
+    ids[0, :10] = rng.integers(1, 512, 10)
+    mask[0, :10] = 1
+    ids[1, :6] = rng.integers(1, 512, 6)
+    mask[1, :6] = 1
+    rep = accuracy.check_accuracy(app, hf, ids, attention_mask=mask,
+                                  max_new_tokens=8)
+    assert rep.passed, rep
+
+
+def test_logit_matching_ragged_batch(hf_dir):
+    app = _app(hf_dir)
+    hf = LlamaFamily.load_hf_model(hf_dir)
+    rng = np.random.default_rng(6)
+    ids = np.zeros((2, 9), np.int64)
+    mask = np.zeros((2, 9), np.int64)
+    ids[0, :9] = rng.integers(1, 512, 9)
+    mask[0, :9] = 1
+    ids[1, :4] = rng.integers(1, 512, 4)
+    mask[1, :4] = 1
+    rep = accuracy.check_accuracy_logits(app, hf, ids, attention_mask=mask,
+                                         max_new_tokens=6,
+                                         divergence_difference_tol=0.005)
+    assert rep.passed, rep
+
+
+def test_benchmark_report_schema(hf_dir, tmp_path):
+    from neuronx_distributed_inference_tpu.utils.benchmark import \
+        benchmark_sampling
+    app = _app(hf_dir, output_logits=False)
+    ids = np.random.default_rng(0).integers(1, 512, size=(2, 8), dtype=np.int64)
+    path = str(tmp_path / "report.json")
+    rep = benchmark_sampling(app, ids.astype(np.int32), max_new_tokens=4,
+                             n_runs=2, report_path=path)
+    assert "e2e_model" in rep and "throughput" in rep["e2e_model"]
+    for k in ("latency_ms_p50", "latency_ms_p99", "latency_ms_avg"):
+        assert k in rep["e2e_model"]
+    with open(path) as f:
+        assert json.load(f)["e2e_model"]["throughput"] > 0
+
+
+def test_cli_run_token_matching(hf_dir, capsys):
+    from neuronx_distributed_inference_tpu.inference_demo import main
+    rc = main(["run", "--model-path", hf_dir, "--batch-size", "1",
+               "--seq-len", "64", "--max-context-length", "32",
+               "--dtype", "float32", "--max-new-tokens", "8",
+               "--prompt-len", "6", "--no-bucketing",
+               "--check-accuracy-mode", "token-matching",
+               "--num-tokens-to-check", "8"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "PASS" in out
