@@ -234,7 +234,10 @@ class TpuConfig:
     kv_cache_quant: bool = False
 
     # --- kernels (reference: models/config.py:417-567 — ~25 enable flags) ---
-    attn_kernel_enabled: Optional[bool] = None   # None = auto heuristic
+    # None/False = XLA attention path (measured faster than the v1 Pallas
+    # kernel on v5e); True = opt into the Pallas flash prefill kernel where
+    # ops/flash_attention.supports() holds (tp=1, arange positions)
+    attn_kernel_enabled: Optional[bool] = None
     qkv_kernel_enabled: bool = False
     mlp_kernel_enabled: bool = False
     attn_block_tkg_nki_kernel_enabled: bool = False

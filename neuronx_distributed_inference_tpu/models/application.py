@@ -205,6 +205,13 @@ class CausalLMApplication:
         b = input_ids.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
+        elif (not self.tpu_config.is_continuous_batching
+              and not np.array_equal(np.asarray(seq_ids), np.arange(b))):
+            # the decode graph skips the cache row-gather under this static
+            # config (model_base._layer_body), so non-identity seq_ids would
+            # silently read the wrong rows — reject at the boundary
+            raise ValueError("non-identity seq_ids require "
+                             "is_continuous_batching=True")
         fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)

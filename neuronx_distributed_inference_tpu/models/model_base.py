@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import InferenceConfig, TpuConfig
 from ..ops import attention as attn_ops
+from ..ops import flash_attention
 from ..ops import sampling as sampling_ops
 from ..ops.normalization import rms_norm
 from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
@@ -80,6 +81,10 @@ class DecoderSpec:
     embed_scale: Optional[float] = None  # gemma multiplies embeddings
     dtype: Any = jnp.bfloat16
     kv_dtype: Any = jnp.bfloat16
+    # flash-kernel strategy (reference analog: FlashAttentionStrategy,
+    # attention_base.py:90-96): True = use the Pallas flash kernel for
+    # prefill when ops/flash_attention.supports() holds; XLA path otherwise
+    flash_prefill: bool = False
 
     @property
     def scale(self) -> float:
@@ -176,7 +181,9 @@ def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
 
 
 def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
-                cos, sin, mask, seq_ids, positions, phase: str):
+                cos, sin, mask, seq_ids, positions, phase: str,
+                identity_seq_ids: bool = False,
+                arange_positions: bool = False):
     """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D).
 
     phase "prefill": attend within the window only (no prior cache read),
@@ -206,8 +213,21 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     k = apply_rope(k, cos, sin)
 
     if phase == "prefill":
-        attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
-                                logits_soft_cap=spec.attn_soft_cap)
+        # flash kernel requirements beyond supports(): per-row positions must
+        # be arange (the kernel rebuilds causality from array indices — an
+        # offset/chunked prefill must use the mask path), and tp must be 1
+        # until the kernel is shard_map-wrapped (under GSPMD a bare
+        # pallas_call would be all-gathered and run replicated per chip)
+        if (spec.flash_prefill and arange_positions and spec.gqa.tp == 1
+                and flash_attention.supports(
+                    q.shape[1], spec.head_dim, has_sink=False, chunk=0)):
+            attn_out = flash_attention.flash_attention(
+                q, k, v, scale=spec.scale, causal=True,
+                window=spec.sliding_window, soft_cap=spec.attn_soft_cap,
+                interpret=jax.default_backend() != "tpu")
+        else:
+            attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
+                                    logits_soft_cap=spec.attn_soft_cap)
         new_k = kv.write_prefill(k_cache, kv.quantize_kv(k, k_cache.dtype), seq_ids)
         new_v = kv.write_prefill(v_cache, kv.quantize_kv(v, v_cache.dtype), seq_ids)
     else:
@@ -215,8 +235,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                                 seq_ids, positions)
         new_v = kv.write_tokens(v_cache, kv.quantize_kv(v, v_cache.dtype),
                                 seq_ids, positions)
-        k_all = kv.gather_cache_rows(new_k, seq_ids).astype(dtype)
-        v_all = kv.gather_cache_rows(new_v, seq_ids).astype(dtype)
+        if identity_seq_ids and hidden.shape[0] == k_cache.shape[0]:
+            # static guarantee that seq_ids == arange (no continuous
+            # batching): skip the row-gather copy of the whole cache
+            k_all, v_all = new_k.astype(dtype), new_v.astype(dtype)
+        else:
+            k_all = kv.gather_cache_rows(new_k, seq_ids).astype(dtype)
+            v_all = kv.gather_cache_rows(new_v, seq_ids).astype(dtype)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap)
 
@@ -234,7 +259,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 
 
 def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
-               seq_ids, positions, phase: str):
+               seq_ids, positions, phase: str,
+               identity_seq_ids: bool = False,
+               arange_positions: bool = False):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -245,7 +272,8 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
     def body(carry, xs):
         layer_w, kc, vc = xs
         h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, cos, sin, mask,
-                                seq_ids, positions, phase)
+                                seq_ids, positions, phase, identity_seq_ids,
+                                arange_positions)
         return h, (nk, nv)
 
     hidden, (new_k, new_v) = jax.lax.scan(
@@ -288,8 +316,11 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
     hidden = _embed(spec, params, input_ids)
+    # context_encoding_step always feeds arange positions per row (the host
+    # shim builds them); chunked/offset prefill variants must pass False
     hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
-                                   seq_ids, position_ids, "prefill")
+                                   seq_ids, position_ids, "prefill",
+                                   arange_positions=True)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -316,7 +347,8 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                                 window=spec.sliding_window)
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
-                                   seq_ids, position_ids, "decode")
+                                   seq_ids, position_ids, "decode",
+                                   identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
     out = {"cache": new_cache}
     if tpu_cfg.output_logits:
@@ -324,6 +356,25 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     out["tokens"] = sampling_ops.sample(
         logits[:, -1, :], tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
+
+
+def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
+                           cache, input_ids, position_ids, seq_ids):
+    """Decode forward over T tokens returning logits at EVERY position —
+    the target-verify graph of fused speculation (reference: target model
+    scoring all candidate tokens, model_base.py:2617-2642). Within-step
+    causality falls out of the cache-write-then-attend order plus the
+    position mask."""
+    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    cache_len = cache["k"].shape[2]
+    mask = attn_ops.decode_mask(position_ids, cache_len,
+                                window=spec.sliding_window)
+    hidden = _embed(spec, params, input_ids)
+    hidden, new_cache = run_layers(
+        spec, params, cache, hidden, cos, sin, mask, seq_ids, position_ids,
+        "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
+    logits = _lm_head(spec, params, hidden)
+    return {"logits_all": logits[..., :spec.vocab_size], "cache": new_cache}
 
 
 def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
@@ -409,6 +460,12 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         sliding_window=0,
         dtype=tcfg.jax_dtype,
         kv_dtype=tcfg.jax_kv_dtype,
+        # default: XLA path — measured faster than the v1 Pallas kernel on
+        # v5e at every prefill length (XLA's fused attention avoids the
+        # kernel's layout transposes); the kernel stays opt-in via
+        # attn_kernel_enabled until it beats XLA (reference keeps the same
+        # dual-path structure, attention_base.py:985-1034)
+        flash_prefill=bool(tcfg.attn_kernel_enabled),
     )
     kw.update(overrides)
     return DecoderSpec(**kw)

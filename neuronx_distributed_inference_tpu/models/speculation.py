@@ -1,0 +1,190 @@
+"""Speculative decoding (reference: models/model_base.py ``NeuronFusedSpecModel``
+:1598-3021 and the vanilla speculation submodel).
+
+Two modes, mirroring the reference:
+
+* **Vanilla speculation** — separate draft and target apps; the host loop
+  alternates k draft steps and one target verify call
+  (reference: utils/hf_adapter.py assisted decoding :439-632).
+* **Fused speculation** — draft loop + target verify + acceptance in ONE
+  jitted graph per step (reference: _token_gen_forward :1812-1929): the
+  draft's k-step autoregressive loop is a ``lax.scan``, the target scores
+  all k+1 candidate positions in one batched forward, and acceptance is the
+  cumsum-of-mismatch trick (reference: :2726-2730).
+
+Greedy speculation is exactly equivalent to greedy decoding — the tests
+assert token-identical output vs the plain decode path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import TpuConfig
+from . import model_base
+from .model_base import DecoderSpec
+
+
+def draft_k_tokens(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                   first_token, positions, seq_ids, k: int):
+    """Run k greedy draft steps (lax.scan). Returns (draft_tokens (B, k),
+    cache). first_token (B,), positions (B,) = position of first_token."""
+
+    def step(carry, _):
+        tok, pos, cch = carry
+        out = model_base.token_generation_step(
+            spec, tpu_cfg, params, cch, tok[:, None], pos[:, None], seq_ids,
+            None, jax.random.PRNGKey(0))
+        return (out["tokens"], pos + 1, out["cache"]), out["tokens"]
+
+    (_, _, new_cache), toks = jax.lax.scan(
+        step, (first_token, positions, cache), None, length=k)
+    return jnp.transpose(toks, (1, 0)), new_cache
+
+
+def fused_speculation_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
+                           tpu_cfg: TpuConfig, draft_params, target_params,
+                           draft_cache, target_cache, last_token, positions,
+                           seq_ids, rng):
+    """One fused speculation step (reference: _token_gen_forward :1812-1929).
+
+    last_token (B,): last accepted token. positions (B,): its position.
+    Returns dict(tokens (B, k+1), num_accepted (B,), caches).
+    Greedy acceptance: accept draft token i iff target's greedy choice at
+    position i equals it; always emit one bonus token from the target
+    (reference acceptance: cumsum-of-mismatch :2726-2730).
+    """
+    k = tpu_cfg.speculation_length
+    b = last_token.shape[0]
+
+    # 1) k-step draft loop (in-graph scan; reference unrolls :2552-2611)
+    draft_tokens, new_draft_cache = draft_k_tokens(
+        draft_spec, tpu_cfg, draft_params, draft_cache, last_token, positions,
+        seq_ids, k)
+
+    # 2) target verifies all k+1 positions in one forward
+    #    (reference: target_model(candidate_ids…) :2617-2642)
+    cand = jnp.concatenate([last_token[:, None], draft_tokens], axis=1)  # (B, k+1)
+    cand_pos = positions[:, None] + jnp.arange(k + 1, dtype=positions.dtype)
+    t_out = model_base.token_generation_multi(
+        target_spec, tpu_cfg, target_params, target_cache, cand, cand_pos,
+        seq_ids)
+    target_logits = t_out["logits_all"]            # (B, k+1, V)
+    new_target_cache = t_out["cache"]
+    target_greedy = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+
+    # 3) acceptance: n_matches = count of leading draft tokens equal to the
+    #    target's choices (cumsum-of-mismatch, reference :2726-2730)
+    mismatch = (draft_tokens != target_greedy[:, :k]).astype(jnp.int32)
+    n_accepted = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)  # (B,) in [0, k]
+
+    # 4) emitted tokens: accepted draft tokens then the target's correction /
+    #    bonus token at position n_accepted
+    idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    accepted_mask = idx < n_accepted[:, None]
+    bonus = jnp.take_along_axis(target_greedy, n_accepted[:, None], axis=1)
+    tokens = jnp.where(accepted_mask,
+                       jnp.concatenate([draft_tokens,
+                                        jnp.zeros((b, 1), jnp.int32)], axis=1),
+                       jnp.where(idx == n_accepted[:, None], bonus, 0))
+    return {
+        "tokens": tokens,                 # (B, k+1); positions > n_accepted are 0
+        "num_accepted": n_accepted + 1,   # emitted per row (accepted + bonus)
+        "draft_cache": new_draft_cache,
+        "target_cache": new_target_cache,
+    }
+
+
+class SpeculativeDecoder:
+    """Host orchestration for fused speculation
+    (reference: NeuronBaseForCausalLM fused-spec routing :3078,
+    hf_adapter fused decode loop :495).
+
+    Wraps a target CausalLMApplication and a draft CausalLMApplication that
+    share batch geometry; both caches advance together. The per-row emitted
+    count varies, so rows advance at different positions — handled exactly
+    like the reference by tracking per-row positions.
+    """
+
+    def __init__(self, target_app, draft_app):
+        from .application import CausalLMApplication  # noqa: F401 (typing)
+        self.target = target_app
+        self.draft = draft_app
+        cfg = target_app.tpu_config
+        if not cfg.speculation_config or cfg.speculation_config.speculation_length < 1:
+            raise ValueError("target app needs speculation_config.speculation_length >= 1")
+        self.k = cfg.speculation_config.speculation_length
+        self._step_fn = None
+
+    def _build_step(self):
+        if self._step_fn is None:
+            fn = partial(fused_speculation_step, self.draft.spec,
+                         self.target.spec, self.target.tpu_config)
+            self._step_fn = jax.jit(fn, donate_argnums=(2, 3))
+        return self._step_fn
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None,
+                 attention_mask: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """Greedy speculative generation; exactly matches greedy decode."""
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_lens = attention_mask.astype(np.int32).sum(axis=1)
+
+        # prefill BOTH models (reference: EAGLE/fused CTE runs both)
+        t_out = self.target._run_prefill(input_ids.astype(np.int32), seq_lens)
+        self.draft._run_prefill(input_ids.astype(np.int32), seq_lens)
+        first = np.asarray(t_out["tokens"]).astype(np.int32)   # (B,)
+
+        step = self._build_step()
+        out_rows = [[int(first[i])] for i in range(b)]
+        last = first
+        positions = seq_lens.astype(np.int32)
+        seq_ids = np.arange(b, dtype=np.int32)
+        done = np.zeros((b,), bool)
+        total_accepted_stats = []
+        max_total = self.target.tpu_config.seq_len
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + self.k + 1 < max_total
+               and not done.all()):
+            res = step(self.draft.params, self.target.params,
+                       self.draft.cache, self.target.cache,
+                       jnp.asarray(last), jnp.asarray(positions),
+                       jnp.asarray(seq_ids), jax.random.PRNGKey(0))
+            self.draft.cache = res["draft_cache"]
+            self.target.cache = res["target_cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_accepted"])
+            total_accepted_stats.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                row = toks[i, :n_emit[i]].tolist()
+                for t in row:
+                    out_rows[i].append(int(t))
+                    if eos_token_id is not None and t == eos_token_id:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            last = toks[np.arange(b), n_emit - 1].astype(np.int32)
+
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+            if len(row) < max_new_tokens:
+                gen[i, len(row):] = row[-1] if eos_token_id is None else eos_token_id
+        mean_emitted = (float(np.mean(np.concatenate(total_accepted_stats)))
+                        if total_accepted_stats else 0.0)
+        return {
+            "sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen,
+            "mean_tokens_per_step": mean_emitted,
+        }

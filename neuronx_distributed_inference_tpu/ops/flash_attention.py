@@ -1,0 +1,142 @@
+"""Pallas flash attention for context encoding — TPU-native replacement for
+the reference's NKI flash kernel ``nkilib.core.attention.attention_cte``
+(reference: modules/attention/attention_base.py:72-85, kernel dispatch
+:565-770, strategy selection :985-1034).
+
+Online-softmax tiling over K/V blocks with causal block skipping; supports
+sliding-window masking and logit soft-cap. GQA is handled by mapping each Q
+head's grid row to its KV head in the BlockSpec index map (no KV head
+materialization, unlike repeat_kv).
+
+Layouts: q/k/v (B, H, S, D) inside the kernel; the public wrapper takes the
+model's (B, S, H, D) and transposes. All softmax math fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.3819763e38  # close to f32 min; matches jax flash impls
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, block_q: int, block_k: int, causal: bool,
+                  window: int, soft_cap: Optional[float]):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    # causal block skip: block contributes only if its first key pos can be
+    # attended by the last query pos of this q block
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+    if window > 0:
+        # skip blocks entirely left of every query's window
+        run = jnp.logical_and(run, k_start + block_k > q_start - window + 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)          # (bq, D)
+        k = k_ref[0, 0].astype(jnp.float32)          # (bk, D)
+        v = v_ref[0, 0].astype(jnp.float32)          # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (bq, bk)
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        qpos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = jnp.ones((block_q, block_k), jnp.bool_)
+        if causal:
+            mask = kpos <= qpos
+        if window > 0:
+            mask = jnp.logical_and(mask, qpos - kpos < window)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, 0:1]                       # (bq, 1)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                       # (bq, bk)
+        l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:, 0:1] = m_cur
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        # causal guarantees l > 0 (each query attends at least itself)
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, 0:1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "causal", "window", "soft_cap", "block_q",
+                     "block_k", "interpret"))
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    scale: float, causal: bool = True, window: int = 0,
+                    soft_cap: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False) -> jnp.ndarray:
+    """q (B, S, Hq, D); k/v (B, S, Hkv, D) -> (B, S, Hq, D).
+
+    S must be a multiple of the block sizes (callers pad to bucket sizes that
+    are powers of two >= 128, so this holds; see supports()).
+    """
+    b, s, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))      # (B, Hq, S, D)
+    kt = jnp.transpose(k, (0, 2, 1, 3))      # (B, Hkv, S, D)
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    grid = (b, hq, s // block_q, s // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        causal=causal, window=window, soft_cap=soft_cap)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, i, j: (bi, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, h, i, j, g=g: (bi, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, h, i, j, g=g: (bi, h // g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, h, i, j: (bi, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def supports(seq_len: int, head_dim: int, has_sink: bool, chunk: int,
+             block: int = 128) -> bool:
+    """Strategy gate (reference analog: FlashAttentionStrategy selection,
+    attention_base.py:985-1034). The XLA path remains the fallback."""
+    return (seq_len % block == 0 and seq_len >= block
+            and head_dim % 64 == 0 and not has_sink and chunk == 0)

@@ -1,0 +1,97 @@
+"""Speculative decoding tests: greedy fused speculation must be
+token-identical to plain greedy decoding (reference invariant for the fused
+spec graph; test strategy per SURVEY §4)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (SpeculationConfig,
+                                                      TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (LlamaFamily,
+                                                            LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.models.speculation import \
+    SpeculativeDecoder
+
+from conftest import tiny_llama_hf_config
+
+
+def _save(tmp_path_factory, name, seed, **over):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(seed)
+    m = LlamaForCausalLM(LlamaConfig(**tiny_llama_hf_config(**over)))
+    m.eval()
+    d = tmp_path_factory.mktemp(name)
+    m.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def target_dir(tmp_path_factory):
+    return _save(tmp_path_factory, "target", seed=0)
+
+
+@pytest.fixture(scope="module")
+def draft_dir(tmp_path_factory):
+    # smaller draft (2 layers) with the same vocab
+    return _save(tmp_path_factory, "draft", seed=1, num_hidden_layers=2,
+                 hidden_size=32, intermediate_size=64)
+
+
+def _build(d, spec_len=0):
+    spec_cfg = SpeculationConfig(speculation_length=spec_len) if spec_len else None
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=False, speculation_config=spec_cfg)
+    icfg = LlamaInferenceConfig(tcfg, load_config=load_pretrained_config(d))
+    return CausalLMApplication(d, icfg, LlamaFamily).load_weights().init_cache()
+
+
+def test_fused_speculation_matches_greedy(target_dir, draft_dir):
+    ids = np.random.default_rng(0).integers(1, 512, size=(2, 8), dtype=np.int32)
+
+    plain = _build(target_dir)
+    ref = plain.generate(ids, max_new_tokens=20)
+
+    spec = SpeculativeDecoder(_build(target_dir, spec_len=4),
+                              _build(draft_dir))
+    res = spec.generate(ids, max_new_tokens=20)
+    np.testing.assert_array_equal(res["generated"][:, :20],
+                                  ref["generated"][:, :20])
+    # speculation must emit at least 1 token per step, usually more
+    assert res["mean_tokens_per_step"] >= 1.0
+
+
+def test_self_speculation_accepts_everything(target_dir):
+    """Draft == target -> every draft token accepted (k+1 per step)."""
+    ids = np.random.default_rng(1).integers(1, 512, size=(2, 6), dtype=np.int32)
+    k = 3
+    spec = SpeculativeDecoder(_build(target_dir, spec_len=k),
+                              _build(target_dir))
+    res = spec.generate(ids, max_new_tokens=12)
+    # not exactly k+1: the draft (T=1) and verify (T=k+1) graphs have
+    # different matmul reduction orders, so near-tie argmaxes can flip
+    assert res["mean_tokens_per_step"] >= k
+
+    plain = _build(target_dir)
+    ref = plain.generate(ids, max_new_tokens=12)
+    np.testing.assert_array_equal(res["generated"][:, :12],
+                                  ref["generated"][:, :12])
+
+
+def test_speculation_with_eos_stops(target_dir, draft_dir):
+    ids = np.random.default_rng(2).integers(1, 512, size=(2, 6), dtype=np.int32)
+    plain = _build(target_dir)
+    ref = plain.generate(ids, max_new_tokens=16)
+    # pick a token that actually appears in the plain output as "eos"
+    eos = int(ref["generated"][0, 3])
+    spec = SpeculativeDecoder(_build(target_dir, spec_len=4),
+                              _build(draft_dir))
+    res = spec.generate(ids, max_new_tokens=16, eos_token_id=eos)
+    row = res["generated"][0].tolist()
+    assert eos in row
+    first_eos = row.index(eos)
+    np.testing.assert_array_equal(row[:first_eos + 1],
+                                  ref["generated"][0, :first_eos + 1].tolist())
