@@ -57,7 +57,11 @@ class CausalLMApplication:
         self.tpu_config: TpuConfig = config.tpu_config
         self.family = family or family_for_config(config)
         self.mesh = mesh if mesh is not None else mesh_from_config(self.tpu_config)
-        self.spec = self.family.build_spec(config, tp_degree=self.mesh.shape["tp"])
+        # heads/vocab/mlp shard over the COMBINED ("ep","tp") axes, so GQA
+        # padding and vocab padding resolve against ep*tp (the reference's
+        # full tp_degree; ep subdivides it, moe_v2.py:135-161)
+        mp_degree = self.mesh.shape["tp"] * self.mesh.shape["ep"]
+        self.spec = self.family.build_spec(config, tp_degree=mp_degree)
         self.params = None
         self.cache = None
         self._compiled: Dict[Tuple[str, int], Any] = {}

@@ -105,10 +105,8 @@ class DecoderFamily:
             "o_proj": layer_stack(p + ".layers.{i}.self_attn.o_proj.weight", o_t),
             "post_norm": layer_stack(
                 p + ".layers.{i}.post_attention_layernorm.weight", ident),
-            "gate_proj": layer_stack(p + ".layers.{i}.mlp.gate_proj.weight", t),
-            "up_proj": layer_stack(p + ".layers.{i}.mlp.up_proj.weight", t),
-            "down_proj": layer_stack(p + ".layers.{i}.mlp.down_proj.weight", t),
         }
+        layers.update(cls.convert_mlp_weights(get, layer_stack, spec))
         if spec.qkv_bias:
             def q_b(b):
                 return place_q_weight(b, g, D)
@@ -137,6 +135,48 @@ class DecoderFamily:
         if not spec.tie_word_embeddings:
             out["lm_head"] = np.ascontiguousarray(vpad(get("lm_head.weight")).T)
         return out
+
+    # -- MLP / MoE weight conversion hook --
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec: DecoderSpec
+                            ) -> Dict[str, np.ndarray]:
+        """Dense gate/up/down by default; MoE families override
+        (reference analog: per-model convert_hf_to_neuron_state_dict MoE
+        branches, e.g. mixtral/dbrx)."""
+        p = cls.hf_prefix
+
+        def t(w):
+            return np.ascontiguousarray(w.T)
+
+        return {
+            "gate_proj": layer_stack(p + ".layers.{i}.mlp.gate_proj.weight", t),
+            "up_proj": layer_stack(p + ".layers.{i}.mlp.up_proj.weight", t),
+            "down_proj": layer_stack(p + ".layers.{i}.mlp.down_proj.weight", t),
+        }
+
+    @classmethod
+    def convert_moe_weights(cls, get, spec: DecoderSpec, router_name: str,
+                            expert_fmt: str, gate: str, up: str, down: str
+                            ) -> Dict[str, np.ndarray]:
+        """Shared MoE conversion: stack per-layer routers (fp32, transposed to
+        (H,E)) and per-layer-per-expert projections to (L,E,in,out). Name
+        templates use {i} (layer), {e} (expert), {name} (projection)."""
+        L, E = spec.num_layers, spec.moe.num_experts
+
+        def experts(name):
+            return np.stack([
+                np.stack([np.ascontiguousarray(np.asarray(get(
+                    expert_fmt.format(i=i, e=e, name=name))).T)
+                    for e in range(E)]) for i in range(L)])
+
+        return {
+            "router": np.stack([np.ascontiguousarray(np.asarray(get(
+                router_name.format(i=i))).T.astype(np.float32))
+                for i in range(L)]),
+            "expert_gate": experts(gate),
+            "expert_up": experts(up),
+            "expert_down": experts(down),
+        }
 
     # -- golden --
     @classmethod

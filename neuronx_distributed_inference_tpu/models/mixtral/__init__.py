@@ -1,0 +1,4 @@
+from .modeling_mixtral import (MixtralFamily, MixtralInferenceConfig,
+                               TpuMixtralForCausalLM)
+
+__all__ = ["MixtralFamily", "MixtralInferenceConfig", "TpuMixtralForCausalLM"]

@@ -36,10 +36,13 @@ from ..ops import sampling as sampling_ops
 from ..ops.normalization import rms_norm
 from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
 from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
+                               expert_column_parallel, expert_row_parallel,
                                replicated_param, resolve_gqa_sharding,
                                row_parallel)
-from ..parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
+                             shard_constraint as _shard)
 from ..modules import kv_cache as kv
+from ..modules.moe import MoESpec, moe_block
 
 ACT_FNS = {
     "silu": jax.nn.silu,
@@ -85,6 +88,10 @@ class DecoderSpec:
     # attention_base.py:90-96): True = use the Pallas flash kernel for
     # prefill when ops/flash_attention.supports() holds; XLA path otherwise
     flash_prefill: bool = False
+    # MoE: when set, the MLP block is a routed mixture of experts
+    # (reference: modules/moe_v2.py; intermediate_size then refers to the
+    # per-expert intermediate)
+    moe: Optional[MoESpec] = None
 
     @property
     def scale(self) -> float:
@@ -119,24 +126,45 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         "v_proj": column_parallel(H, spec.kv_size, dt, True, L),
         "o_proj": row_parallel(spec.q_size, H, dt, True, L),
         "post_norm": ParamSpec((L, H), P(), dt, "ones"),
-        "gate_proj": column_parallel(H, I, dt, True, L),
-        "up_proj": column_parallel(H, I, dt, True, L),
-        "down_proj": row_parallel(I, H, dt, True, L),
     }
+    if spec.moe is None:
+        layers.update({
+            "gate_proj": column_parallel(H, I, dt, True, L),
+            "up_proj": column_parallel(H, I, dt, True, L),
+            "down_proj": row_parallel(I, H, dt, True, L),
+        })
+    else:
+        m = spec.moe
+        E, Ie = m.num_experts, m.intermediate_size
+        layers.update({
+            "router": ParamSpec((L, H, E), P(), jnp.float32),
+            "expert_gate": expert_column_parallel(E, H, Ie, dt, True, L),
+            "expert_up": expert_column_parallel(E, H, Ie, dt, True, L),
+            "expert_down": expert_row_parallel(E, Ie, H, dt, True, L),
+        })
+        if m.has_router_bias:
+            layers["router_bias"] = ParamSpec((L, E), P(), jnp.float32, "zeros")
+        if m.shared_intermediate > 0:
+            Is = m.shared_intermediate
+            layers.update({
+                "shared_gate": column_parallel(H, Is, dt, True, L),
+                "shared_up": column_parallel(H, Is, dt, True, L),
+                "shared_down": row_parallel(Is, H, dt, True, L),
+            })
     if spec.qkv_bias:
-        layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_TP), dt, "zeros")
-        layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_TP), dt, "zeros")
-        layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_TP), dt, "zeros")
+        layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP), dt, "zeros")
+        layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+        layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
     if spec.qk_norm:
         layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
         layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
     out: Dict[str, Any] = {
-        "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_TP, None), dt),
+        "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_MP, None), dt),
         "layers": layers,
         "final_norm": ParamSpec((H,), P(), dt, "ones"),
     }
     if not spec.tie_word_embeddings:
-        out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_TP), dt)
+        out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_MP), dt)
     return out
 
 
@@ -167,12 +195,6 @@ def param_shardings(spec: DecoderSpec, mesh: Mesh):
 # Layer stack
 # ---------------------------------------------------------------------------
 
-def _shard(x, *spec):
-    """Sharding-constraint helper; no-op outside a mesh context."""
-    try:
-        return jax.lax.with_sharding_constraint(x, P(*spec))
-    except (ValueError, RuntimeError):
-        return x
 
 
 def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
@@ -203,9 +225,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         q = q + layer_w["q_bias"]
         k = k + layer_w["k_bias"]
         v = v + layer_w["v_bias"]
-    q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
-    k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
-    v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_TP, None)
+    q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+    k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+    v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
     if spec.qk_norm:
         q = rms_norm(q, layer_w["q_norm"], spec.rms_eps)
         k = rms_norm(k, layer_w["k_norm"], spec.rms_eps)
@@ -250,10 +272,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     hidden = hidden + _shard(h, AXIS_DP, None, None)
 
     h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps)
-    act = ACT_FNS[spec.act]
-    inter = act(h @ layer_w["gate_proj"]) * (h @ layer_w["up_proj"])
-    inter = _shard(inter, AXIS_DP, None, AXIS_TP)
-    h = inter @ layer_w["down_proj"]
+    if spec.moe is not None:
+        h = moe_block(spec.moe, h, layer_w)
+    else:
+        act = ACT_FNS[spec.act]
+        inter = act(h @ layer_w["gate_proj"]) * (h @ layer_w["up_proj"])
+        inter = _shard(inter, AXIS_DP, None, AXIS_MP)
+        h = inter @ layer_w["down_proj"]
     hidden = hidden + _shard(h, AXIS_DP, None, None)
     return hidden, new_k, new_v
 
@@ -299,7 +324,7 @@ def _lm_head(spec: DecoderSpec, params, hidden):
     if spec.logits_soft_cap:
         logits = spec.logits_soft_cap * jnp.tanh(logits / spec.logits_soft_cap)
     logits = sampling_ops.mask_padded_logits(logits, spec.padded_vocab - spec.vocab_size)
-    return _shard(logits, AXIS_DP, None, AXIS_TP)
+    return _shard(logits, AXIS_DP, None, AXIS_MP)
 
 
 def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,

@@ -1,0 +1,5 @@
+from .modeling_qwen3_moe import (Qwen3MoeFamily, Qwen3MoeInferenceConfig,
+                                 TpuQwen3MoeForCausalLM)
+
+__all__ = ["Qwen3MoeFamily", "Qwen3MoeInferenceConfig",
+           "TpuQwen3MoeForCausalLM"]

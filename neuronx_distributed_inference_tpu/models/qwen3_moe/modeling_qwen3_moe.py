@@ -1,0 +1,65 @@
+"""Qwen3-MoE family (reference: models/qwen3_moe/modeling_qwen3_moe.py
+``NeuronQwen3MoeForCausalLM`` — MoE + EP flagship of the reference hub).
+
+Qwen3 attention (per-head q/k RMSNorm, decoupled head_dim) + Mixtral-style
+routing (softmax, top-k, optional renormalization via ``norm_topk_prob``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules.moe import MoESpec
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+
+
+class Qwen3MoeInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "head_dim",
+                "num_experts", "num_experts_per_tok", "moe_intermediate_size"]
+
+
+@register_family("qwen3_moe")
+class Qwen3MoeFamily(DecoderFamily):
+    config_cls = Qwen3MoeInferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig,
+                   tp_degree: Optional[int] = None) -> DecoderSpec:
+        if getattr(config, "mlp_only_layers", None):
+            raise NotImplementedError(
+                "qwen3_moe mlp_only_layers (mixed dense/MoE stacks) not "
+                "supported yet")
+        if getattr(config, "decoder_sparse_step", 1) != 1:
+            raise NotImplementedError("decoder_sparse_step != 1 not supported")
+        moe = MoESpec(
+            num_experts=config.num_experts,
+            top_k=config.num_experts_per_tok,
+            intermediate_size=config.moe_intermediate_size,
+            normalize_topk=bool(getattr(config, "norm_topk_prob", True)),
+            act=getattr(config, "hidden_act", "silu"),
+        )
+        return spec_from_config(config, tp_degree, moe=moe, qk_norm=True,
+                                intermediate_size=config.moe_intermediate_size)
+
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec: DecoderSpec
+                            ) -> Dict[str, np.ndarray]:
+        """HF names: mlp.gate.weight (E,H) router;
+        mlp.experts.{e}.gate_proj/up_proj/down_proj."""
+        p = cls.hf_prefix
+        return cls.convert_moe_weights(
+            get, spec,
+            router_name=p + ".layers.{i}.mlp.gate.weight",
+            expert_fmt=p + ".layers.{i}.mlp.experts.{e}.{name}.weight",
+            gate="gate_proj", up="up_proj", down="down_proj")
+
+
+def TpuQwen3MoeForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, Qwen3MoeFamily)

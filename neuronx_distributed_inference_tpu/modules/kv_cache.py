@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import AXIS_DP, AXIS_TP
+from ..parallel.mesh import AXIS_DP, AXIS_MP
 
 
 @dataclass(frozen=True)
@@ -58,7 +58,7 @@ class KVCacheSpec:
 
 
 def cache_pspec() -> P:
-    return P(None, AXIS_DP, None, AXIS_TP, None)
+    return P(None, AXIS_DP, None, AXIS_MP, None)
 
 
 def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None):

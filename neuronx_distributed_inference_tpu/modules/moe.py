@@ -1,0 +1,172 @@
+"""Mixture-of-Experts block — TPU-native replacement for the reference's NxD
+MoE stack (reference: modules/moe_v2.py ``initialize_moe_module`` building
+RouterTopK + ExpertMLPsV2 + SharedExperts, and the all-experts decode MoE
+kernel ``moe_token_gen`` noted in SURVEY §2.10).
+
+Design:
+  * Router: replicated (H, E) matmul in fp32, softmax (or sigmoid for
+    DeepSeek-style routers), top-k, optional renormalization and routed
+    scaling (reference: MoENeuronConfig knobs, models/config.py:798-846).
+  * Experts, dense path: ALL experts compute on all tokens, outputs combined
+    with the (B,T,E) routing weights. This mirrors the reference's decode
+    all-experts kernel; for the small T of token generation the expert matmuls
+    are batched into one einsum that XLA maps onto the MXU. Expert dim shards
+    on mesh axis "ep" (moe_ep), intermediate dim on "tp" (moe_tp) — the
+    combine-sum over E emits a psum over "ep" automatically.
+  * Experts, ragged path (prefill): tokens are sorted by expert and run
+    through grouped matmuls via ``jax.lax.ragged_dot`` — the dropless
+    TPU-native analog of the reference's blockwise matmul
+    (MoENeuronConfig blockwise configs). Used when T is large enough that
+    all-experts compute would dominate.
+  * Shared experts (reference: SharedExperts in moe_v2.py:104) are a plain
+    dense MLP added to the routed output.
+
+All routing math in fp32 (router logits decide tokens; bf16 tie-breaks
+diverge from HF goldens).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.mesh import (AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
+                             shard_constraint)
+
+
+@dataclass(frozen=True)
+class MoESpec:
+    """Static MoE architecture description (hashable; closed over by jit)."""
+
+    num_experts: int
+    top_k: int
+    intermediate_size: int           # per-expert intermediate
+    normalize_topk: bool = True      # renormalize top-k affinities
+    routed_scaling: Optional[float] = None
+    router_act: str = "softmax"      # "softmax" | "sigmoid"
+    pre_softmax_topk: bool = False   # top-k on raw logits, then act over k
+    shared_intermediate: int = 0     # 0 = no shared experts
+    act: str = "silu"
+    # bias added to router scores for expert selection only (DeepSeek-V3
+    # e_score_correction_bias); affinity weights still use raw scores
+    has_router_bias: bool = False
+    # TOTAL-token-count (B*T) threshold at or below which the dense
+    # all-experts path is used; above it the ragged sorted-grouped-matmul
+    # path runs. Decode (B*1 tokens) stays dense up to batch 64 by default.
+    dense_max_tokens: int = 64
+
+
+def _act_fn(name: str):
+    from ..models.model_base import ACT_FNS
+    return ACT_FNS[name]
+
+
+def route(moe: MoESpec, h: jnp.ndarray, router_w: jnp.ndarray,
+          router_bias: Optional[jnp.ndarray] = None
+          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compute routing: h (B,T,H), router_w (H,E) ->
+    (top_vals (B,T,k) fp32 affinities, top_idx (B,T,k) expert ids).
+
+    Reference: RouterTopK (moe_v2.py:5-15) with the affinity knobs of
+    MoENeuronConfig (normalize_top_k_affinities, routed_scaling_factor).
+    """
+    logits = h.astype(jnp.float32) @ router_w.astype(jnp.float32)  # (B,T,E)
+    if moe.router_act == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    elif moe.pre_softmax_topk:
+        scores = logits
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+    select = scores + router_bias if router_bias is not None else scores
+    _, top_idx = jax.lax.top_k(select, moe.top_k)                  # (B,T,k)
+    top_vals = jnp.take_along_axis(scores, top_idx, axis=-1)
+    if moe.pre_softmax_topk and moe.router_act != "sigmoid":
+        top_vals = jax.nn.softmax(top_vals, axis=-1)
+    if moe.normalize_topk:
+        top_vals = top_vals / jnp.maximum(
+            jnp.sum(top_vals, axis=-1, keepdims=True), 1e-20)
+    if moe.routed_scaling is not None:
+        top_vals = top_vals * moe.routed_scaling
+    return top_vals, top_idx
+
+
+def combine_matrix(num_experts: int, top_vals: jnp.ndarray,
+                   top_idx: jnp.ndarray) -> jnp.ndarray:
+    """Scatter (B,T,k) affinities into a dense (B,T,E) combine matrix."""
+    b, t, _ = top_vals.shape
+    return jnp.zeros((b, t, num_experts), jnp.float32).at[
+        jnp.arange(b)[:, None, None], jnp.arange(t)[None, :, None],
+        top_idx].add(top_vals)
+
+
+def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
+                  top_idx: jnp.ndarray, wg: jnp.ndarray, wu: jnp.ndarray,
+                  wd: jnp.ndarray) -> jnp.ndarray:
+    """All-experts dense compute (reference: moe_token_gen all-experts decode
+    kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H)."""
+    act = _act_fn(moe.act)
+    dt = x.dtype
+    combine = combine_matrix(moe.num_experts, top_vals, top_idx)  # (B,T,E)
+    # (B,T,E,I): expert axis sharded on ep, intermediate on tp
+    gate = jnp.einsum("bth,ehi->btei", x, wg)
+    up = jnp.einsum("bth,ehi->btei", x, wu)
+    inter = shard_constraint(act(gate) * up, AXIS_DP, None, AXIS_EP, AXIS_TP)
+    outs = jnp.einsum("btei,eih->bteh", inter, wd)
+    # combine-weighted sum over E — psum over "ep" + "tp" partial sums
+    y = jnp.einsum("bteh,bte->bth", outs.astype(jnp.float32), combine)
+    return shard_constraint(y.astype(dt), AXIS_DP, None, None)
+
+
+def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
+                   top_idx: jnp.ndarray, wg: jnp.ndarray, wu: jnp.ndarray,
+                   wd: jnp.ndarray) -> jnp.ndarray:
+    """Dropless grouped-matmul path: sort token copies by expert, run
+    ``jax.lax.ragged_dot`` per projection, unsort and combine.
+
+    TPU-native analog of the reference's blockwise MoE matmul
+    (MoENeuronConfig blockwise configs; SURVEY §2.2). Static shapes: the
+    sorted token-copy count is exactly B*T*k.
+    """
+    b, t, h = x.shape
+    k = moe.top_k
+    act = _act_fn(moe.act)
+    dt = x.dtype
+
+    flat_x = x.reshape(b * t, h)
+    flat_expert = top_idx.reshape(-1)                       # (N,) expert ids
+    flat_weight = top_vals.reshape(-1)                      # (N,) fp32
+
+    order = jnp.argsort(flat_expert)                        # stable
+    inv = jnp.argsort(order)
+    sorted_tokens = flat_x[order // k]                      # (N, H)
+    group_sizes = jnp.bincount(flat_expert, length=moe.num_experts
+                               ).astype(jnp.int32)
+
+    gate = jax.lax.ragged_dot(sorted_tokens, wg, group_sizes)
+    up = jax.lax.ragged_dot(sorted_tokens, wu, group_sizes)
+    inter = act(gate) * up                                  # (N, I)
+    outs = jax.lax.ragged_dot(inter, wd, group_sizes)       # (N, H)
+
+    outs = outs[inv].astype(jnp.float32) * flat_weight[:, None]
+    y = outs.reshape(b * t, k, h).sum(axis=1).reshape(b, t, h)
+    return y.astype(dt)
+
+
+def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any]
+              ) -> jnp.ndarray:
+    """Full MoE block: route + experts (+ shared experts). x (B,T,H)."""
+    router_bias = layer_w.get("router_bias") if moe.has_router_bias else None
+    top_vals, top_idx = route(moe, x, layer_w["router"], router_bias)
+    experts = (experts_dense if x.shape[0] * x.shape[1] <= moe.dense_max_tokens
+               else experts_ragged)
+    y = experts(moe, x, top_vals, top_idx, layer_w["expert_gate"],
+                layer_w["expert_up"], layer_w["expert_down"])
+    if moe.shared_intermediate > 0:
+        act = _act_fn(moe.act)
+        s = act(x @ layer_w["shared_gate"]) * (x @ layer_w["shared_up"])
+        s = shard_constraint(s, AXIS_DP, None, AXIS_MP)
+        y = y + s @ layer_w["shared_down"]
+    return y

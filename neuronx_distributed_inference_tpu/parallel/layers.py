@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..parallel.mesh import AXIS_TP
+from ..parallel.mesh import AXIS_EP, AXIS_MP, AXIS_TP
 
 
 @dataclass(frozen=True)
@@ -50,26 +50,51 @@ class ParamSpec:
 
 def column_parallel(in_dim: int, out_dim: int, dtype=jnp.bfloat16,
                     layer_stacked: bool = False, num_layers: int = 0) -> ParamSpec:
-    """Weight (in, out) with the OUTPUT dim sharded on tp — the analog of
-    ColumnParallelLinear (gather_output=False)."""
+    """Weight (in, out) with the OUTPUT dim sharded on the full model-parallel
+    axis set ("ep","tp") — the analog of ColumnParallelLinear
+    (gather_output=False)."""
     if layer_stacked:
-        return ParamSpec((num_layers, in_dim, out_dim), P(None, None, AXIS_TP), dtype)
-    return ParamSpec((in_dim, out_dim), P(None, AXIS_TP), dtype)
+        return ParamSpec((num_layers, in_dim, out_dim), P(None, None, AXIS_MP), dtype)
+    return ParamSpec((in_dim, out_dim), P(None, AXIS_MP), dtype)
 
 
 def row_parallel(in_dim: int, out_dim: int, dtype=jnp.bfloat16,
                  layer_stacked: bool = False, num_layers: int = 0) -> ParamSpec:
-    """Weight (in, out) with the INPUT dim sharded on tp — the analog of
-    RowParallelLinear (input_is_parallel=True); XLA emits the all-reduce."""
+    """Weight (in, out) with the INPUT dim sharded on ("ep","tp") — the analog
+    of RowParallelLinear (input_is_parallel=True); XLA emits the all-reduce."""
     if layer_stacked:
-        return ParamSpec((num_layers, in_dim, out_dim), P(None, AXIS_TP, None), dtype)
-    return ParamSpec((in_dim, out_dim), P(AXIS_TP, None), dtype)
+        return ParamSpec((num_layers, in_dim, out_dim), P(None, AXIS_MP, None), dtype)
+    return ParamSpec((in_dim, out_dim), P(AXIS_MP, None), dtype)
 
 
 def vocab_parallel_embedding(vocab: int, hidden: int, dtype=jnp.bfloat16) -> ParamSpec:
     """Embedding (V, H) sharded on V (reference: ParallelEmbedding with
     vocab_parallel, models/config.py:142)."""
-    return ParamSpec((vocab, hidden), P(AXIS_TP, None), dtype)
+    return ParamSpec((vocab, hidden), P(AXIS_MP, None), dtype)
+
+
+def expert_column_parallel(num_experts: int, in_dim: int, out_dim: int,
+                           dtype=jnp.bfloat16, layer_stacked: bool = False,
+                           num_layers: int = 0) -> ParamSpec:
+    """Expert weight (E, in, out): experts sharded on "ep" (moe_ep), the
+    output dim on "tp" (moe_tp) — reference: modules/moe_v2.py:135-161
+    moe_tp_degree x moe_ep_degree expert sharding."""
+    if layer_stacked:
+        return ParamSpec((num_layers, num_experts, in_dim, out_dim),
+                         P(None, AXIS_EP, None, AXIS_TP), dtype)
+    return ParamSpec((num_experts, in_dim, out_dim),
+                     P(AXIS_EP, None, AXIS_TP), dtype)
+
+
+def expert_row_parallel(num_experts: int, in_dim: int, out_dim: int,
+                        dtype=jnp.bfloat16, layer_stacked: bool = False,
+                        num_layers: int = 0) -> ParamSpec:
+    """Expert weight (E, in, out): experts on "ep", input dim on "tp"."""
+    if layer_stacked:
+        return ParamSpec((num_layers, num_experts, in_dim, out_dim),
+                         P(None, AXIS_EP, AXIS_TP, None), dtype)
+    return ParamSpec((num_experts, in_dim, out_dim),
+                     P(AXIS_EP, AXIS_TP, None), dtype)
 
 
 def replicated_param(shape: Tuple[int, ...], dtype=jnp.bfloat16, init="ones") -> ParamSpec:

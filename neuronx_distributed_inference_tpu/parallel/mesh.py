@@ -40,11 +40,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 logger = logging.getLogger("nxdi_tpu")
 
 # Canonical axis order: outermost (slowest-varying, DCN-friendly) first.
+# ep sits OUTSIDE tp: the reference's moe_tp_degree x moe_ep_degree factors the
+# tp rank set (modules/moe_v2.py:135-161); here "model parallel" dims (heads,
+# mlp intermediate, vocab) shard over the COMBINED ("ep","tp") axes while MoE
+# expert weights shard experts over "ep" and intermediate over "tp".
 AXIS_DP = "dp"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
 AXIS_EP = "ep"
-MESH_AXES = (AXIS_DP, AXIS_CP, AXIS_TP, AXIS_EP)
+MESH_AXES = (AXIS_DP, AXIS_CP, AXIS_EP, AXIS_TP)
+
+# Composite model-parallel spec entry: full tp_degree sharding of a dim.
+AXIS_MP = (AXIS_EP, AXIS_TP)
 
 
 @dataclass(frozen=True)
@@ -56,10 +63,10 @@ class MeshConfig:
 
     @property
     def world_size(self) -> int:
-        # cp and dp shard the tp device set during different phases; ep reuses
-        # tp devices for MoE. The physical world is dp*cp*tp with ep folded
-        # into tp (moe_tp x moe_ep = tp, reference: modules/moe_v2.py:135-161).
-        return self.dp * self.cp * self.tp
+        # cp/dp/ep all subdivide the model-parallel rank set during different
+        # phases/blocks; the physical world is dp*cp*ep*tp
+        # (moe_tp x moe_ep = tp, reference: modules/moe_v2.py:135-161).
+        return self.dp * self.cp * self.ep * self.tp
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -82,20 +89,19 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
 
 
 def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the (dp, cp, tp, ep) mesh.
+    """Build the (dp, cp, ep, tp) mesh.
 
-    ep=1 devices-wise: expert parallelism reuses tp-axis devices via a derived
-    mesh (see :func:`moe_mesh_axes`); only dp*cp*tp physical devices are laid
-    out here. Device order follows jax.devices() which is ICI-contiguous —
-    tp innermost so tp collectives ride the fastest links.
+    Device order follows jax.devices() which is ICI-contiguous — tp innermost
+    so tp collectives ride the fastest links; ep just outside so MoE expert
+    dispatch stays intra-slice.
     """
     if devices is None:
         devices = jax.devices()
-    n = cfg.dp * cfg.cp * cfg.tp
+    n = cfg.dp * cfg.cp * cfg.ep * cfg.tp
     if len(devices) < n:
         raise ValueError(f"mesh needs {n} devices (dp={cfg.dp} cp={cfg.cp} "
-                         f"tp={cfg.tp}), only {len(devices)} available")
-    dev_array = np.array(devices[:n]).reshape(cfg.dp, cfg.cp, cfg.tp, 1)
+                         f"ep={cfg.ep} tp={cfg.tp}), only {len(devices)} available")
+    dev_array = np.array(devices[:n]).reshape(cfg.dp, cfg.cp, cfg.ep, cfg.tp)
     return Mesh(dev_array, MESH_AXES)
 
 
@@ -105,23 +111,34 @@ def single_device_mesh() -> Mesh:
 
 def mesh_from_config(tpu_config) -> Mesh:
     """Build mesh from a TpuConfig's parallelism degrees."""
-    # attention-DP and CP both subdivide the tp rank set in the reference
-    # (tp_degree counts ALL ranks; cp/dp are groupings of them:
-    # attention_process_groups.py:36-163). Here tp axis = tp/(cp*dp), so the
-    # physical world stays tp_degree devices.
+    # attention-DP / CP / EP all subdivide the tp rank set in the reference
+    # (tp_degree counts ALL ranks; cp/dp/ep are groupings of them:
+    # attention_process_groups.py:36-163, moe_v2.py:135-161). Here tp axis =
+    # tp/(cp*dp*ep), so the physical world stays tp_degree devices.
     cp = max(tpu_config.cp_degree, 1)
     dp = max(tpu_config.attention_dp_degree, 1)
-    shrink = cp * dp
+    ep = max(tpu_config.ep_degree, 1)
+    shrink = cp * dp * ep
     if tpu_config.tp_degree % shrink != 0:
         raise ValueError(f"tp_degree {tpu_config.tp_degree} not divisible by "
-                         f"cp_degree*attention_dp_degree = {shrink}")
+                         f"cp*dp*ep = {shrink}")
     return build_mesh(MeshConfig(tp=tpu_config.tp_degree // shrink, cp=cp, dp=dp,
-                                 ep=max(tpu_config.ep_degree, 1)))
+                                 ep=ep))
 
 
 # ---------------------------------------------------------------------------
 # Sharding helpers
 # ---------------------------------------------------------------------------
+
+def shard_constraint(x, *spec):
+    """``with_sharding_constraint`` that no-ops outside a mesh context —
+    the shared helper for model code (traced under jit with a mesh active;
+    plain-eager tests run without one)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, P(*spec))
+    except (ValueError, RuntimeError):
+        return x
+
 
 def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
@@ -149,10 +166,11 @@ DEFAULT_RULES = {
     "batch": AXIS_DP,
     "seq": None,            # sequence sharded only under SP/CP via explicit specs
     "hidden": None,
-    "heads": AXIS_TP,
-    "kv_heads": AXIS_TP,
-    "mlp": AXIS_TP,
-    "vocab": AXIS_TP,
+    "heads": AXIS_MP,
+    "kv_heads": AXIS_MP,
+    "mlp": AXIS_MP,
+    "vocab": AXIS_MP,
     "expert": AXIS_EP,
+    "expert_mlp": AXIS_TP,  # intermediate dim inside an expert (moe_tp)
     "layer": None,
 }
