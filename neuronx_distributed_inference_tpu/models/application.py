@@ -289,12 +289,15 @@ class CausalLMApplication:
         logits_trace = [np.asarray(out["logits"])] if return_logits and "logits" in out else []
         ttft = time.perf_counter() - t0
 
+        # eos_token_id: int or list of ints (HF allows multiple stop ids)
+        eos_ids = (None if eos_token_id is None
+                   else np.atleast_1d(np.asarray(eos_token_id, dtype=np.int64)))
         collected = [tokens]
         positions = seq_lens.astype(np.int32)  # position of the token just sampled
         n_generated = 1
-        eos_seen = np.zeros((b,), bool) if eos_token_id is not None else None
+        eos_seen = np.zeros((b,), bool) if eos_ids is not None else None
         if eos_seen is not None:
-            eos_seen |= tokens[:, 0] == eos_token_id
+            eos_seen |= np.isin(tokens[:, 0], eos_ids)
         chunk = max(self.tpu_config.decode_chunk_tokens, 1)
         while n_generated < max_new_tokens:
             remaining = max_new_tokens - n_generated
@@ -323,17 +326,17 @@ class CausalLMApplication:
                 n_generated += n
             collected.append(new)
             if eos_seen is not None:
-                eos_seen |= (new == eos_token_id).any(axis=1)
+                eos_seen |= np.isin(new, eos_ids).any(axis=1)
                 if eos_seen.all():
                     break
 
         gen = np.concatenate(collected, axis=1)
         # trim past first eos per row (tokens after eos are garbage by HF convention)
-        if eos_token_id is not None:
+        if eos_ids is not None:
             for i in range(b):
-                hits = np.where(gen[i] == eos_token_id)[0]
+                hits = np.where(np.isin(gen[i], eos_ids))[0]
                 if hits.size:
-                    gen[i, hits[0] + 1:] = eos_token_id
+                    gen[i, hits[0] + 1:] = eos_ids[0]
         sequences = np.concatenate([input_ids, gen], axis=1)
         result = {"sequences": sequences, "generated": gen, "ttft_s": ttft,
                   "seq_lens": seq_lens}

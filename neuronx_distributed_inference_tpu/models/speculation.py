@@ -137,6 +137,9 @@ class SpeculativeDecoder:
         if attention_mask is None:
             attention_mask = np.ones_like(input_ids)
         seq_lens = attention_mask.astype(np.int32).sum(axis=1)
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        eos_fill = None if eos_set is None else next(iter(eos_set))
 
         # prefill BOTH models (reference: EAGLE/fused CTE runs both)
         t_out = self.target._run_prefill(input_ids.astype(np.int32), seq_lens)
@@ -169,7 +172,7 @@ class SpeculativeDecoder:
                 row = toks[i, :n_emit[i]].tolist()
                 for t in row:
                     out_rows[i].append(int(t))
-                    if eos_token_id is not None and t == eos_token_id:
+                    if eos_set is not None and int(t) in eos_set:
                         done[i] = True
                         break
             positions = positions + n_emit.astype(np.int32)
@@ -180,7 +183,7 @@ class SpeculativeDecoder:
             row = out_rows[i][:max_new_tokens]
             gen[i, :len(row)] = row
             if len(row) < max_new_tokens:
-                gen[i, len(row):] = row[-1] if eos_token_id is None else eos_token_id
+                gen[i, len(row):] = row[-1] if eos_fill is None else eos_fill
         mean_emitted = (float(np.mean(np.concatenate(total_accepted_stats)))
                         if total_accepted_stats else 0.0)
         return {

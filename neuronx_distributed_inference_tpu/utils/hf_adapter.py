@@ -1,0 +1,198 @@
+"""HuggingFace ``generate()``-compatible adapter
+(reference: utils/hf_adapter.py ``HuggingFaceGenerationAdapter`` :133-890).
+
+Wraps a :class:`CausalLMApplication` so code written against the HF
+transformers generation API works unchanged:
+
+  * torch tensors in / torch tensors out (``GenerateOutput``-shaped dict or
+    plain sequences tensor, matching ``return_dict_in_generate``)
+  * LEFT padding accepted (HF decoder-only convention) and converted to the
+    framework's right-padded layout (reference handles right padding in
+    ``prepare_inputs_for_generation`` :259-335; we normalize at the boundary)
+  * ``GenerationConfig`` / kwargs: max_new_tokens, max_length, do_sample,
+    top_k, top_p, temperature, eos_token_id, pad_token_id
+  * assisted decoding via ``assistant_model`` (reference: :439-632) routed to
+    the fused SpeculativeDecoder
+
+The host loop itself lives in the application layer
+(models/application.py ``generate``); this file is pure adaptation.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..ops.sampling import prepare_sampling_params
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+def _to_numpy(x):
+    if x is None:
+        return None
+    if hasattr(x, "detach"):           # torch tensor
+        return x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+class HuggingFaceGenerationAdapter:
+    """Duck-typed stand-in for a HF ``PreTrainedModel`` in generation code.
+
+    Parameters
+    ----------
+    app : CausalLMApplication (already weight-loaded)
+    generation_config : optional object/dict with HF generation defaults
+    """
+
+    def __init__(self, app, generation_config=None):
+        self.app = app
+        self.config = app.config
+        self.generation_config = generation_config
+        self.device = "tpu"
+
+    # HF code probes these
+    @property
+    def main_input_name(self):
+        return "input_ids"
+
+    def can_generate(self):
+        return True
+
+    def eval(self):
+        return self
+
+    # ------------------------------------------------------------------
+    def _resolve(self, name, kwargs, default=None):
+        if name in kwargs and kwargs[name] is not None:
+            return kwargs[name]
+        gc = kwargs.get("generation_config") or self.generation_config
+        if gc is not None:
+            v = gc.get(name) if isinstance(gc, dict) else getattr(gc, name, None)
+            if v is not None:
+                return v
+        return default
+
+    @staticmethod
+    def _normalize_padding(ids: np.ndarray, mask: np.ndarray):
+        """LEFT-padded rows -> right-padded (framework layout). Rows already
+        right-padded or unpadded pass through untouched."""
+        b, s = ids.shape
+        out_ids = np.zeros_like(ids)
+        out_mask = np.zeros_like(mask)
+        lens = mask.astype(np.int64).sum(axis=1)
+        left_padded = False
+        for i in range(b):
+            pos = np.nonzero(mask[i])[0]
+            n = int(lens[i])
+            if n and not np.array_equal(pos, np.arange(n)):
+                left_padded = True
+            out_ids[i, :n] = ids[i, pos]
+            out_mask[i, :n] = 1
+        return out_ids, out_mask, lens, left_padded
+
+    # ------------------------------------------------------------------
+    def generate(self, input_ids=None, attention_mask=None,
+                 assistant_model=None, return_dict_in_generate: bool = False,
+                 **kwargs):
+        """HF-compatible generation entry point.
+
+        Returns a torch LongTensor ``sequences`` (prompt + generated, in the
+        caller's original padding layout) or a dict when
+        ``return_dict_in_generate=True``.
+        """
+        ids = _to_numpy(input_ids).astype(np.int64)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, s = ids.shape
+        mask = _to_numpy(attention_mask)
+        if mask is None:
+            mask = np.ones_like(ids)
+        mask = mask.astype(np.int64)
+
+        max_new = self._resolve("max_new_tokens", kwargs)
+        if max_new is None:
+            max_length = self._resolve("max_length", kwargs,
+                                       self.app.tpu_config.seq_len)
+            max_new = max(int(max_length) - s, 1)
+        eos = self._resolve("eos_token_id", kwargs)
+        if isinstance(eos, (list, tuple)) and not eos:
+            eos = None
+        pad_id = self._resolve("pad_token_id", kwargs)
+        if pad_id is None:
+            pad_id = (eos[0] if isinstance(eos, (list, tuple)) else eos) \
+                if eos is not None else 0
+
+        do_sample = bool(self._resolve("do_sample", kwargs, False))
+        sampling_params = None
+        if do_sample:
+            sampling_params = prepare_sampling_params(
+                b,
+                self._resolve("top_k", kwargs, 0) or 0,
+                self._resolve("top_p", kwargs, 1.0),
+                self._resolve("temperature", kwargs, 1.0))
+
+        r_ids, r_mask, lens, _ = self._normalize_padding(ids, mask)
+
+        if assistant_model is not None:
+            if do_sample:
+                logger.warning("assisted decoding is greedy-only; ignoring "
+                               "do_sample/top_k/top_p/temperature")
+            out = self._assisted_generate(assistant_model, r_ids, r_mask,
+                                          int(max_new), eos)
+        else:
+            out = self.app.generate(
+                r_ids, attention_mask=r_mask, max_new_tokens=int(max_new),
+                eos_token_id=eos, sampling_params=sampling_params)
+
+        gen = out["generated"]                           # (B, T)
+        n_gen = gen.shape[1]
+        # HF layout contract: sequences[:, :s] is the caller's input block
+        # UNCHANGED (whatever its padding side); generated tokens start at
+        # column s, truncated at the first eos then padded with pad_id —
+        # so the universal idiom ``out[:, input_ids.shape[1]:]`` yields
+        # exactly the new tokens.
+        eos_arr = (np.atleast_1d(np.asarray(eos, dtype=np.int64))
+                   if eos is not None else None)
+        seqs = np.full((b, s + n_gen), pad_id, dtype=np.int64)
+        seqs[:, :s] = ids
+        for i in range(b):
+            row = gen[i]
+            if eos_arr is not None:
+                hits = np.nonzero(np.isin(row, eos_arr))[0]
+                if hits.size:
+                    row = row[:hits[0] + 1]
+            seqs[i, s:s + len(row)] = row
+        result = _maybe_torch(seqs)
+        if return_dict_in_generate:
+            d: Dict[str, Any] = {"sequences": result}
+            if "mean_tokens_per_step" in out:
+                d["mean_tokens_per_step"] = out["mean_tokens_per_step"]
+            return d
+        return result
+
+    # ------------------------------------------------------------------
+    def _assisted_generate(self, assistant_model, r_ids, r_mask, max_new, eos):
+        """Assisted decoding (reference: hf_adapter.py:439-632). The
+        assistant may be another adapter, a CausalLMApplication, or a
+        prebuilt SpeculativeDecoder."""
+        from ..models.speculation import SpeculativeDecoder
+        if isinstance(assistant_model, SpeculativeDecoder):
+            dec = assistant_model
+        else:
+            draft_app = getattr(assistant_model, "app", assistant_model)
+            dec = SpeculativeDecoder(self.app, draft_app)
+        return dec.generate(r_ids, max_new_tokens=max_new, eos_token_id=eos,
+                            attention_mask=r_mask)
+
+    __call__ = generate
+
+
+def _maybe_torch(a: np.ndarray):
+    try:
+        import torch
+        return torch.from_numpy(np.ascontiguousarray(a))
+    except ImportError:        # torch always present in practice; keep soft
+        return a

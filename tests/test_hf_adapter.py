@@ -1,0 +1,119 @@
+"""HF generate() adapter tests
+(reference analog: utils/hf_adapter.py HuggingFaceGenerationAdapter)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (LlamaFamily,
+                                                            LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.utils.hf_adapter import \
+    HuggingFaceGenerationAdapter
+
+from conftest import tiny_llama_hf_config
+
+
+@pytest.fixture(scope="module")
+def hf_dir(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(3)
+    m = LlamaForCausalLM(LlamaConfig(**tiny_llama_hf_config()))
+    m.eval()
+    d = tmp_path_factory.mktemp("tiny_adapter")
+    m.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def app(hf_dir):
+    icfg = LlamaInferenceConfig(
+        TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                  enable_bucketing=False),
+        load_config=load_pretrained_config(hf_dir))
+    return CausalLMApplication(hf_dir, icfg, LlamaFamily).load_weights().init_cache()
+
+
+def test_right_padded_matches_app_generate(app):
+    ids = np.random.default_rng(0).integers(1, 512, size=(2, 8), dtype=np.int64)
+    adapter = HuggingFaceGenerationAdapter(app)
+    seqs = adapter.generate(torch.tensor(ids), max_new_tokens=6)
+    assert isinstance(seqs, torch.Tensor)
+    app.reset()
+    direct = app.generate(ids, max_new_tokens=6)["sequences"]
+    np.testing.assert_array_equal(seqs.numpy(), direct)
+    app.reset()
+
+
+def test_left_padding_normalized(app):
+    """HF-convention left-padded batch: sequences[:, :s] must be the caller's
+    input block unchanged and sequences[:, s:] exactly the new tokens."""
+    rng = np.random.default_rng(1)
+    p0 = rng.integers(1, 512, size=5, dtype=np.int64)
+    p1 = rng.integers(1, 512, size=8, dtype=np.int64)
+    s = 8
+    ids = np.zeros((2, s), np.int64)
+    mask = np.zeros((2, s), np.int64)
+    ids[0, s - 5:] = p0; mask[0, s - 5:] = 1       # left padded
+    ids[1, :] = p1; mask[1, :] = 1
+    adapter = HuggingFaceGenerationAdapter(app)
+    seqs = adapter.generate(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                            max_new_tokens=5, pad_token_id=0).numpy()
+    app.reset()
+    # golden: each row unpadded, batch=2 right layout
+    r_ids = np.zeros((2, 8), np.int64); r_mask = np.zeros((2, 8), np.int64)
+    r_ids[0, :5] = p0; r_mask[0, :5] = 1
+    r_ids[1, :] = p1; r_mask[1, :] = 1
+    direct = app.generate(r_ids, attention_mask=r_mask, max_new_tokens=5)
+    app.reset()
+    # input block unchanged; new tokens start at column s for every row
+    np.testing.assert_array_equal(seqs[:, :s], ids)
+    np.testing.assert_array_equal(seqs[0, s:], direct["generated"][0])
+    np.testing.assert_array_equal(seqs[1, s:], direct["generated"][1])
+
+
+def test_multi_eos_token_ids(app):
+    """HF allows a LIST of eos ids; generation must stop on any of them and
+    pad after the first hit."""
+    ids = np.random.default_rng(5).integers(1, 512, size=(1, 6), dtype=np.int64)
+    adapter = HuggingFaceGenerationAdapter(app)
+    app.reset()
+    free = adapter.generate(torch.tensor(ids), max_new_tokens=8,
+                            pad_token_id=0).numpy()
+    # pick the 2nd generated token as a fake eos — the run must stop there
+    stop = int(free[0, 6 + 1])
+    app.reset()
+    seqs = adapter.generate(torch.tensor(ids), max_new_tokens=8,
+                            eos_token_id=[999999, stop],
+                            pad_token_id=0).numpy()
+    row = seqs[0, 6:]
+    assert row[1] == stop
+    assert (row[2:] == 0).all()     # padded with pad_id after eos
+    app.reset()
+
+
+def test_generation_config_and_dict_output(app):
+    ids = np.random.default_rng(2).integers(1, 512, size=(2, 6), dtype=np.int64)
+
+    class GC:  # minimal GenerationConfig stand-in
+        max_new_tokens = 4
+        do_sample = False
+        eos_token_id = None
+        pad_token_id = 0
+
+    adapter = HuggingFaceGenerationAdapter(app, generation_config=GC())
+    out = adapter.generate(torch.tensor(ids), return_dict_in_generate=True)
+    assert out["sequences"].shape == (2, 10)
+    app.reset()
+
+
+def test_sampling_path_runs(app):
+    ids = np.random.default_rng(3).integers(1, 512, size=(2, 6), dtype=np.int64)
+    adapter = HuggingFaceGenerationAdapter(app)
+    seqs = adapter.generate(torch.tensor(ids), max_new_tokens=4,
+                            do_sample=True, top_k=5, temperature=0.7)
+    assert seqs.shape == (2, 10)
+    app.reset()
