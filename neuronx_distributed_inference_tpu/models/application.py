@@ -330,16 +330,8 @@ class CausalLMApplication:
                 if eos_seen.all():
                     break
 
-        gen = np.concatenate(collected, axis=1)
-        # trim past first eos per row (tokens after eos are garbage by HF convention)
-        if eos_ids is not None:
-            for i in range(b):
-                hits = np.where(np.isin(gen[i], eos_ids))[0]
-                if hits.size:
-                    gen[i, hits[0] + 1:] = eos_ids[0]
-        sequences = np.concatenate([input_ids, gen], axis=1)
-        result = {"sequences": sequences, "generated": gen, "ttft_s": ttft,
-                  "seq_lens": seq_lens}
+        result = _finalize_generation(input_ids, collected, eos_ids, ttft,
+                                      seq_lens)
         if return_logits:
             result["logits"] = logits_trace
         return result
@@ -347,4 +339,249 @@ class CausalLMApplication:
     def reset(self):
         """Clear KV cache between requests."""
         self.init_cache()
+        return self
+
+
+def _finalize_generation(input_ids: np.ndarray, collected, eos_ids,
+                         ttft: float, seq_lens: np.ndarray) -> Dict[str, Any]:
+    """Shared tail of the generation loops: concat steps, trim past the first
+    eos per row (tokens after eos are garbage by HF convention), assemble the
+    result dict."""
+    gen = np.concatenate(collected, axis=1)
+    if eos_ids is not None:
+        for i in range(gen.shape[0]):
+            hits = np.where(np.isin(gen[i], eos_ids))[0]
+            if hits.size:
+                gen[i, hits[0] + 1:] = eos_ids[0]
+    return {"sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen, "ttft_s": ttft, "seq_lens": seq_lens}
+
+
+class PagedCausalLMApplication(CausalLMApplication):
+    """Paged-KV (block layout) application with prefix caching
+    (reference: BlockKVCacheManager + vLLM-facing surface;
+    enabled by ``is_block_kv_layout`` / ``is_prefix_caching``,
+    models/config.py:277-317).
+
+    One jitted graph (model_base.paged_forward_step) serves prefill,
+    prefix-cached continuation and decode; the host side owns the block
+    allocator and tables.
+    """
+
+    def init_cache(self):
+        from ..modules.block_kv_cache import BlockKVCacheManager, BlockKVSpec
+        cfg = self.tpu_config
+        bspec = BlockKVSpec(
+            num_layers=self.spec.num_layers,
+            num_blocks=cfg.pa_num_blocks + 1,    # +1: reserved null block 0
+            block_size=cfg.pa_block_size,
+            num_kv_heads=self.spec.gqa.num_kv_heads,
+            head_dim=self.spec.head_dim,
+            dtype=self.spec.kv_dtype,
+        )
+        self.kv_mgr = BlockKVCacheManager(
+            bspec, self.mesh, enable_prefix_caching=cfg.is_prefix_caching)
+        # single owner of the live (donated) buffers is the application; the
+        # manager keeps allocator + tables only (its .cache would become a
+        # stale donated alias after the first step)
+        self.cache = self.kv_mgr.cache
+        self.kv_mgr.cache = None
+        # static block-table width for the jitted graphs
+        self.max_blocks = bspec.blocks_for(cfg.seq_len)
+        return self
+
+    def _jit_paged(self):
+        fn = partial(model_base.paged_forward_step, self.spec, self.tpu_config)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def get_compiled(self, tag: str, bucket: int = 0):
+        if tag == "paged_forward":
+            key = (tag, bucket)
+            if key not in self._compiled:
+                self._compiled[key] = self._jit_paged()
+            return self._compiled[key]
+        return super().get_compiled(tag, bucket)
+
+    def _run_paged(self, input_ids, position_ids, slot_mapping, block_table,
+                   last_idx, sampling_params=None):
+        fn = self.get_compiled("paged_forward")
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(input_ids.shape[0])
+        out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                 jnp.asarray(position_ids), jnp.asarray(slot_mapping),
+                 jnp.asarray(block_table), jnp.asarray(last_idx),
+                 sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        return out
+
+    def warmup(self):
+        """AOT-compile the paged graph at each shape it will run: the prefill
+        window (ctx bucket or chunk width) and the T=1 decode step. Dummy
+        calls write nothing (all slots negative → dropped)."""
+        if self.params is None:
+            self.init_random_weights()
+        if not hasattr(self, "kv_mgr") or self.cache is None:
+            self.init_cache()
+        cfg = self.tpu_config
+        b = cfg.batch_size
+        widths = {1}
+        if (cfg.is_chunked_prefill and cfg.chunked_prefill_config is not None):
+            widths.add(cfg.chunked_prefill_config.kernel_q_tile_size)
+        widths.update(self.ctx_buckets)
+        bt = np.zeros((b, self.max_blocks), np.int32)   # null block only
+        for w in sorted(widths):
+            self._run_paged(np.zeros((b, w), np.int32),
+                            np.zeros((b, w), np.int32),
+                            np.full((b, w), -1, np.int32), bt,
+                            np.zeros((b,), np.int32))
+        return self
+
+    def generate(self, input_ids: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None,
+                 sampling_params: Optional[np.ndarray] = None,
+                 return_logits: bool = False,
+                 teacher_tokens: Optional[np.ndarray] = None) -> Dict[str, Any]:
+        """Paged generation. Prefix-cached prompt blocks are skipped
+        (not recomputed); the rest mirrors CausalLMApplication.generate."""
+        from ..modules.block_kv_cache import slots_from_table
+        if teacher_tokens is not None:
+            raise NotImplementedError("teacher forcing uses the contiguous app")
+        logits_trace: List[np.ndarray] = []
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_lens = attention_mask.astype(np.int32).sum(axis=1)
+        if self.params is None:
+            raise RuntimeError("load_weights() or init_random_weights() first")
+        if not hasattr(self, "kv_mgr") or self.cache is None:
+            self.init_cache()
+        if sampling_params is not None:
+            sampling_params = jnp.asarray(sampling_params)
+        eos_ids = (None if eos_token_id is None
+                   else np.atleast_1d(np.asarray(eos_token_id, dtype=np.int64)))
+
+        # --- allocate blocks; discover cached prefix per row ---
+        cfg = self.tpu_config
+        chunked = (cfg.is_chunked_prefill
+                   and cfg.chunked_prefill_config is not None)
+        cached = np.zeros((b,), np.int32)
+        bsz = self.kv_mgr.spec.block_size
+        batch_fresh: set = set()      # blocks first written by THIS call
+        for i in range(b):
+            toks = input_ids[i, :seq_lens[i]].tolist()
+            blocks, c = self.kv_mgr.begin_sequence(i, toks)
+            if chunked:
+                # chunked prefill writes sibling rows' blocks chunk by chunk,
+                # so a prefix hit on a block allocated earlier in this SAME
+                # batch may read slots the sibling hasn't written yet — cut
+                # the cached prefix at the first such block (recomputing a
+                # shared block writes identical values, so this is safe)
+                for bi in range(c // bsz):
+                    if blocks[bi] in batch_fresh:
+                        c = bi * bsz
+                        break
+            batch_fresh.update(blocks[c // bsz:])
+            # always recompute >= 1 token so there are logits to sample from
+            cached[i] = min(c, seq_lens[i] - 1)
+        bt = self.kv_mgr.block_table_array(range(b), self.max_blocks)
+
+        # --- prefill the uncached suffixes ---
+        suffix_lens = seq_lens - cached
+        t_max = int(suffix_lens.max())
+        chunk_w = (cfg.chunked_prefill_config.kernel_q_tile_size
+                   if chunked else 0)
+
+        def _prefill_window(off_w, width, last_idx):
+            """One paged-prefill call over window [off, off+width) of each
+            row's uncached suffix (off_w: (B,) per-row offsets)."""
+            ids_w = np.zeros((b, width), np.int32)
+            pos_w = np.zeros((b, width), np.int32)
+            for i in range(b):
+                lo = cached[i] + off_w[i]
+                n = int(np.clip(seq_lens[i] - lo, 0, width))
+                ids_w[i, :n] = input_ids[i, lo:lo + n]
+                pos_w[i] = lo + np.arange(width, dtype=np.int32)
+            valid = (np.arange(width)[None, :]
+                     < (seq_lens - cached - off_w)[:, None])
+            # padded tail positions: writes dropped via negative slots,
+            # outputs never sampled
+            slot_pos = np.where(valid, pos_w, -1)
+            slots = slots_from_table(bt, slot_pos, self.kv_mgr.spec.block_size)
+            return self._run_paged(ids_w, pos_w, slots, bt, last_idx,
+                                   sampling_params)
+
+        t0 = time.perf_counter()
+        if chunk_w and t_max > chunk_w:
+            # chunked prefill (reference: windowed context encoding,
+            # model_base.py:878-933 + ChunkedPrefillConfig): walk the suffix
+            # in fixed windows re-invoking the same graph with growing KV
+            n_chunks = -(-t_max // chunk_w)
+            tokens = np.zeros((b, 1), np.int32)
+            off = np.zeros((b,), np.int32)
+            for c in range(n_chunks):
+                last_idx = np.clip(suffix_lens - 1 - off, 0, chunk_w - 1)
+                out = _prefill_window(off, chunk_w, last_idx)
+                toks = np.asarray(out["tokens"]).reshape(b)
+                final_here = ((suffix_lens - 1 >= off)
+                              & (suffix_lens - 1 < off + chunk_w))
+                tokens[final_here, 0] = toks[final_here]
+                off = off + chunk_w
+        else:
+            bucket = autobucketing.get_target_bucket(self.ctx_buckets, t_max)
+            out = _prefill_window(np.zeros((b,), np.int32), bucket,
+                                  np.maximum(suffix_lens - 1, 0))
+            tokens = np.asarray(out["tokens"]).reshape(b, 1)
+        ttft = time.perf_counter() - t0
+        if return_logits and "logits" in out:
+            logits_trace.append(np.asarray(out["logits"]))
+
+        collected = [tokens]
+        positions = seq_lens.astype(np.int32)
+        n_generated = 1
+        eos_seen = np.zeros((b,), bool) if eos_ids is not None else None
+        if eos_seen is not None:
+            eos_seen |= np.isin(tokens[:, 0], eos_ids)
+        while n_generated < max_new_tokens:
+            if int(positions.max()) >= self.tpu_config.seq_len:
+                break
+            for i in range(b):
+                self.kv_mgr.grow(i)
+            bt = self.kv_mgr.block_table_array(range(b), self.max_blocks)
+            cur = collected[-1][:, -1:].astype(np.int32)
+            pos = positions[:, None]
+            slots = slots_from_table(bt, pos, self.kv_mgr.spec.block_size)
+            o = self._run_paged(cur, pos, slots, bt, np.zeros((b,), np.int32),
+                                sampling_params)
+            new = np.asarray(o["tokens"]).reshape(b, 1)
+            if return_logits and "logits" in o:
+                logits_trace.append(np.asarray(o["logits"]))
+            collected.append(new)
+            positions = positions + 1
+            n_generated += 1
+            if eos_seen is not None:
+                eos_seen |= np.isin(new, eos_ids).any(axis=1)
+                if eos_seen.all():
+                    break
+
+        result = _finalize_generation(input_ids, collected, eos_ids, ttft,
+                                      seq_lens)
+        result["cached_tokens"] = cached.copy()
+        if return_logits:
+            result["logits"] = logits_trace
+        return result
+
+    def release(self, seq_ids=None):
+        """Return sequences' blocks to the allocator (prefix-cached blocks
+        stay resident for reuse)."""
+        ids = list(self.kv_mgr.tables) if seq_ids is None else list(seq_ids)
+        for sid in ids:
+            if sid in self.kv_mgr.tables:
+                self.kv_mgr.end_sequence(sid)
+        return self
+
+    def reset(self):
+        self.release()
         return self

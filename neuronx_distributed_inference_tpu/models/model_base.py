@@ -205,8 +205,12 @@ def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
 def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                 cos, sin, mask, seq_ids, positions, phase: str,
                 identity_seq_ids: bool = False,
-                arange_positions: bool = False):
-    """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D).
+                arange_positions: bool = False,
+                slot_mapping=None, block_table=None):
+    """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D) — or, in
+    the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
+    ``block_table`` set (phase "paged", reference:
+    modules/kvcache/block_kv_cache_manager.py).
 
     phase "prefill": attend within the window only (no prior cache read),
       then write the window into the cache (reference CTE path).
@@ -214,6 +218,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
       (reference TKG path; the reference's decomposed prior/active attention
       attention_base.py:1383-1461 is one fused softmax over the cache here —
       XLA fuses it, no manual decomposition needed).
+    phase "paged": write at slot_mapping, gather via block_table, attend over
+      the gathered view — covers paged prefill, prefix-cached continuation,
+      chunked prefill and paged decode with one body.
     """
     g = spec.gqa
     dtype = hidden.dtype
@@ -234,7 +241,17 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    if phase == "prefill":
+    if phase == "paged":
+        from ..modules import block_kv_cache as bkv
+        new_k = bkv.write_slots(k_cache, kv.quantize_kv(k, k_cache.dtype),
+                                slot_mapping)
+        new_v = bkv.write_slots(v_cache, kv.quantize_kv(v, v_cache.dtype),
+                                slot_mapping)
+        k_all = bkv.gather_block_kv(new_k, block_table).astype(dtype)
+        v_all = bkv.gather_block_kv(new_v, block_table).astype(dtype)
+        attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
+                                logits_soft_cap=spec.attn_soft_cap)
+    elif phase == "prefill":
         # flash kernel requirements beyond supports(): per-row positions must
         # be arange (the kernel rebuilds causality from array indices — an
         # offset/chunked prefill must use the mask path), and tp must be 1
@@ -286,7 +303,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
                seq_ids, positions, phase: str,
                identity_seq_ids: bool = False,
-               arange_positions: bool = False):
+               arange_positions: bool = False,
+               slot_mapping=None, block_table=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -298,7 +316,7 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
         layer_w, kc, vc = xs
         h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, cos, sin, mask,
                                 seq_ids, positions, phase, identity_seq_ids,
-                                arange_positions)
+                                arange_positions, slot_mapping, block_table)
         return h, (nk, nv)
 
     hidden, (new_k, new_v) = jax.lax.scan(
@@ -400,6 +418,41 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
         "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
     return {"logits_all": logits[..., :spec.vocab_size], "cache": new_cache}
+
+
+def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                       input_ids, position_ids, slot_mapping, block_table,
+                       last_idx, sampling_params, rng):
+    """Unified paged-KV step graph (reference:
+    modules/kvcache/block_kv_cache_manager.py + the prefix-caching prefill of
+    attention_base.py:772-914). One graph covers:
+
+      * paged prefill            (T = window, positions from 0)
+      * prefix-cached prefill    (T = uncached suffix, positions offset)
+      * chunked prefill          (T = chunk, positions at running offset)
+      * paged decode             (T = 1)
+
+    input_ids (B, T); position_ids (B, T) absolute positions;
+    slot_mapping (B, T) flat cache slots (negative = drop);
+    block_table (B, max_blocks); last_idx (B,) index into T of the token whose
+    logits are sampled. Cache layout (L, N_blocks, Bs, Hkv, D).
+    """
+    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    kv_len = block_table.shape[1] * cache["k"].shape[2]
+    mask = attn_ops.decode_mask(position_ids, kv_len, window=spec.sliding_window)
+    hidden = _embed(spec, params, input_ids)
+    hidden, new_cache = run_layers(
+        spec, params, cache, hidden, cos, sin, mask, None, position_ids,
+        "paged", slot_mapping=slot_mapping, block_table=block_table)
+    idx = last_idx[:, None, None].astype(jnp.int32)
+    last_h = jnp.take_along_axis(hidden, idx, axis=1)
+    logits = _lm_head(spec, params, last_h)[:, 0, :]
+    out = {"cache": new_cache}
+    if tpu_cfg.output_logits:
+        out["logits"] = _lm_head(spec, params, hidden)[..., :spec.vocab_size]
+    out["tokens"] = sampling_ops.sample(
+        logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
+    return out
 
 
 def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,

@@ -1,0 +1,275 @@
+"""Paged (block) KV cache — TPU-native analog of the reference's
+``BlockKVCacheManager`` (reference: modules/kvcache/block_kv_cache_manager.py,
+431 LoC) plus the host-side block allocator with vLLM-style prefix caching
+(the reference exposes the same surface to vLLM via ``slot_mapping`` /
+``active_block_table`` inputs).
+
+Device layout:
+  k, v : (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+  sharded P(None, None, None, ("ep","tp"), None) — heads sharded, blocks
+  replicated across dp (each dp shard could own a block range; that variant
+  arrives with attention-DP decode).
+
+In-graph ops (pure, used inside the jitted step):
+  * ``write_slots``       — scatter new K/V at flat slot ids
+    (reference: write via slot_mapping, block_kv_cache_manager.py:268-375)
+  * ``gather_block_kv``   — assemble a per-request (B, S, H, D) view from an
+    ``active_block_table`` (reference: :183-267 gather via block table)
+
+Host side:
+  * ``BlockAllocator`` — free-list allocator + content-hash prefix cache
+    (reference analog: vLLM's block manager; prefix-caching bucket logic
+    model_wrapper.py:923-1045 selects buckets from cached-prefix length).
+
+Block 0 is reserved as the NULL block: slot_mapping entries < 0 drop writes,
+block_table entries 0 read zeros (masked out by the position mask anyway).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import AXIS_MP
+
+
+@dataclass(frozen=True)
+class BlockKVSpec:
+    num_layers: int
+    num_blocks: int            # includes the reserved null block 0
+    block_size: int
+    num_kv_heads: int          # padded/replicated per GQASharding
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.num_layers, self.num_blocks, self.block_size,
+                self.num_kv_heads, self.head_dim)
+
+    def blocks_for(self, seq_len: int) -> int:
+        return -(-seq_len // self.block_size)
+
+
+def block_cache_pspec() -> P:
+    return P(None, None, None, AXIS_MP, None)
+
+
+def init_block_cache(spec: BlockKVSpec, mesh: Optional[Mesh] = None):
+    if mesh is not None:
+        sharding = NamedSharding(mesh, block_cache_pspec())
+        zeros = lambda: jax.device_put(jnp.zeros(spec.shape, spec.dtype), sharding)
+    else:
+        zeros = lambda: jnp.zeros(spec.shape, spec.dtype)
+    return {"k": zeros(), "v": zeros()}
+
+
+# ---------------------------------------------------------------------------
+# In-graph ops (operate on ONE layer's cache, called inside the layer scan)
+# ---------------------------------------------------------------------------
+
+def write_slots(cache_layer: jnp.ndarray, new: jnp.ndarray,
+                slot_mapping: jnp.ndarray) -> jnp.ndarray:
+    """Scatter tokens into flat slots.
+
+    cache_layer (N, Bs, H, D); new (B, T, H, D); slot_mapping (B, T) flat slot
+    ids (block*block_size + offset), negative = drop (padding).
+    """
+    n, bs, h, d = cache_layer.shape
+    flat = cache_layer.reshape(n * bs, h, d)
+    slots = slot_mapping.reshape(-1)
+    # negative indices WRAP in jax scatter (slot -1 = last flat slot, which is
+    # a real allocated block) — remap them past the end so mode="drop"
+    # actually drops them
+    slots = jnp.where(slots < 0, n * bs, slots)
+    vals = new.astype(cache_layer.dtype).reshape(-1, h, d)
+    flat = flat.at[slots].set(vals, mode="drop", unique_indices=False)
+    return flat.reshape(n, bs, h, d)
+
+
+def gather_block_kv(cache_layer: jnp.ndarray, block_table: jnp.ndarray
+                    ) -> jnp.ndarray:
+    """Assemble per-request contiguous KV from the block table.
+
+    cache_layer (N, Bs, H, D); block_table (B, max_blocks) int32 →
+    (B, max_blocks*Bs, H, D). Table entries 0 = null block (zeros).
+    """
+    g = cache_layer[block_table]               # (B, max_blocks, Bs, H, D)
+    b, mb, bs, h, d = g.shape
+    return g.reshape(b, mb * bs, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Host-side slot-mapping construction
+# ---------------------------------------------------------------------------
+
+def slots_from_table(block_table: np.ndarray, positions: np.ndarray,
+                     block_size: int) -> np.ndarray:
+    """positions (B, T) in-sequence token positions -> flat slot ids (B, T)
+    using each row's block table. Negative positions stay negative (drop)."""
+    blk_idx = positions // block_size
+    offs = positions % block_size
+    blocks = np.take_along_axis(
+        np.asarray(block_table), np.maximum(blk_idx, 0), axis=1)
+    slots = blocks * block_size + offs
+    return np.where(positions < 0, -1, slots).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Block allocator + prefix cache (host)
+# ---------------------------------------------------------------------------
+
+def _hash_block(parent: bytes, tokens: Sequence[int]) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent)
+    h.update(np.asarray(tokens, np.int64).tobytes())
+    return h.digest()
+
+
+@dataclass
+class _BlockMeta:
+    ref_count: int = 0
+    content_hash: Optional[bytes] = None   # set only for FULL immutable blocks
+
+
+class BlockAllocator:
+    """Free-list block allocator with content-hash prefix caching.
+
+    * ``allocate(seq)`` returns (block_ids, num_cached_tokens): full prompt
+      blocks whose content hash is already resident are reused (ref_count++)
+      and need no recompute; the remainder are fresh blocks.
+    * ``free(block_ids)`` decrements refs; cached blocks stay resident until
+      evicted LRU when the free list runs dry.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True):
+        self.block_size = block_size
+        self.enable_prefix_caching = enable_prefix_caching
+        self.num_blocks = num_blocks
+        self.meta: Dict[int, _BlockMeta] = {i: _BlockMeta() for i in range(1, num_blocks)}
+        self.free_list: List[int] = list(range(1, num_blocks))  # 0 = null block
+        self.hash_to_block: Dict[bytes, int] = {}
+        self._lru: List[int] = []          # cached, ref_count==0, oldest first
+
+    @property
+    def num_free(self) -> int:
+        return len(self.free_list) + len(self._lru)
+
+    def _pop_block(self) -> int:
+        if self.free_list:
+            return self.free_list.pop()
+        if self._lru:                      # evict the oldest unreferenced cached block
+            blk = self._lru.pop(0)
+            h = self.meta[blk].content_hash
+            if h is not None:
+                self.hash_to_block.pop(h, None)
+            self.meta[blk] = _BlockMeta()
+            return blk
+        raise RuntimeError("out of KV cache blocks")
+
+    def allocate(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+        """Allocate blocks for a prompt. Returns (block_ids, cached_tokens)."""
+        n_blocks = max(1, -(-len(token_ids) // self.block_size))
+        blocks: List[int] = []
+        cached_tokens = 0
+        parent = b""
+        matching = self.enable_prefix_caching
+        for bi in range(n_blocks):
+            chunk = token_ids[bi * self.block_size:(bi + 1) * self.block_size]
+            full = len(chunk) == self.block_size
+            h = _hash_block(parent, chunk) if (matching and full) else None
+            if h is not None and h in self.hash_to_block:
+                blk = self.hash_to_block[h]
+                m = self.meta[blk]
+                if m.ref_count == 0 and blk in self._lru:
+                    self._lru.remove(blk)
+                m.ref_count += 1
+                blocks.append(blk)
+                cached_tokens += self.block_size
+                parent = h
+                continue
+            matching = False                # prefix broken; rest are fresh
+            blk = self._pop_block()
+            m = self.meta[blk]
+            m.ref_count += 1
+            if self.enable_prefix_caching and full:
+                hh = _hash_block(parent, chunk)
+                m.content_hash = hh
+                self.hash_to_block[hh] = blk
+                parent = hh
+            blocks.append(blk)
+        return blocks, cached_tokens
+
+    def extend(self, blocks: List[int], new_len: int) -> List[int]:
+        """Grow a running sequence's block list to cover ``new_len`` tokens."""
+        need = max(1, -(-new_len // self.block_size))
+        while len(blocks) < need:
+            blk = self._pop_block()
+            self.meta[blk].ref_count += 1
+            blocks.append(blk)
+        return blocks
+
+    def free(self, blocks: Sequence[int]):
+        for blk in blocks:
+            m = self.meta[blk]
+            m.ref_count -= 1
+            if m.ref_count < 0:
+                raise RuntimeError(f"double free of block {blk}")
+            if m.ref_count == 0:
+                if m.content_hash is not None:
+                    self._lru.append(blk)  # keep resident for prefix reuse
+                else:
+                    self.free_list.append(blk)
+
+
+class BlockKVCacheManager:
+    """Host-side owner: spec + cache pytree + allocator + per-seq block tables
+    (reference: BlockKVCacheManager + the vLLM-facing surface)."""
+
+    def __init__(self, spec: BlockKVSpec, mesh: Optional[Mesh] = None,
+                 enable_prefix_caching: bool = True):
+        self.spec = spec
+        self.mesh = mesh
+        self.cache = init_block_cache(spec, mesh)
+        self.allocator = BlockAllocator(spec.num_blocks, spec.block_size,
+                                        enable_prefix_caching)
+        self.tables: Dict[int, List[int]] = {}     # seq_id -> block list
+        self.lens: Dict[int, int] = {}
+
+    def begin_sequence(self, seq_id: int, token_ids: Sequence[int]
+                       ) -> Tuple[List[int], int]:
+        if seq_id in self.tables:      # stale table from an unreleased run
+            self.end_sequence(seq_id)  # (would otherwise leak its blocks)
+        blocks, cached = self.allocator.allocate(token_ids)
+        self.tables[seq_id] = blocks
+        self.lens[seq_id] = len(token_ids)
+        return blocks, cached
+
+    def grow(self, seq_id: int, n_new: int = 1) -> List[int]:
+        self.lens[seq_id] += n_new
+        self.tables[seq_id] = self.allocator.extend(
+            self.tables[seq_id], self.lens[seq_id])
+        return self.tables[seq_id]
+
+    def end_sequence(self, seq_id: int):
+        self.allocator.free(self.tables.pop(seq_id))
+        self.lens.pop(seq_id)
+
+    def block_table_array(self, seq_ids: Sequence[int], max_blocks: int
+                          ) -> np.ndarray:
+        out = np.zeros((len(seq_ids), max_blocks), np.int32)
+        for i, sid in enumerate(seq_ids):
+            blks = self.tables.get(sid, [])[:max_blocks]
+            out[i, :len(blks)] = blks
+        return out
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return max((len(b) for b in self.tables.values()), default=1)

@@ -1,0 +1,189 @@
+"""Paged/block KV cache tests (reference analog:
+test/unit/modules/kvcache block manager tests + prefix caching)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, TpuConfig
+from neuronx_distributed_inference_tpu.models.application import (
+    CausalLMApplication, PagedCausalLMApplication)
+from neuronx_distributed_inference_tpu.models.llama import (LlamaFamily,
+                                                            LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.block_kv_cache import (
+    BlockAllocator, BlockKVSpec, gather_block_kv, slots_from_table, write_slots)
+
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_basic_and_free():
+    a = BlockAllocator(num_blocks=8, block_size=4, enable_prefix_caching=False)
+    blocks, cached = a.allocate(list(range(10)))   # 3 blocks
+    assert len(blocks) == 3 and cached == 0
+    assert a.num_free == 4
+    a.free(blocks)
+    assert a.num_free == 7
+    with pytest.raises(RuntimeError):
+        a.free(blocks[:1])
+
+
+def test_allocator_prefix_reuse():
+    a = BlockAllocator(num_blocks=16, block_size=4)
+    p = list(range(100, 112))                      # 3 full blocks
+    b1, c1 = a.allocate(p)
+    assert c1 == 0
+    b2, c2 = a.allocate(p + [7, 8])                # same prefix + extra
+    assert c2 == 12                                # all 3 full blocks reused
+    assert b2[:3] == b1[:3]
+    # divergent prefix shares only the common full blocks
+    q = p[:8] + [999, 998, 997, 996]
+    b3, c3 = a.allocate(q)
+    assert c3 == 8 and b3[:2] == b1[:2] and b3[2] != b1[2]
+
+
+def test_allocator_cached_block_eviction():
+    a = BlockAllocator(num_blocks=5, block_size=2)   # 4 usable
+    b1, _ = a.allocate([1, 2, 3, 4])                 # 2 full blocks cached
+    a.free(b1)                                       # refs 0, stay resident
+    assert a.num_free == 4
+    b2, c2 = a.allocate([1, 2, 3, 4])                # comes back from cache
+    assert c2 == 4 and b2 == b1
+    a.free(b2)
+    # exhaust: need 4 fresh blocks for different content -> evicts cached
+    b3, c3 = a.allocate([9, 9, 9, 9, 9, 9, 9, 9])
+    assert c3 == 0 and len(b3) == 4
+
+
+# ---------------------------------------------------------------------------
+# device ops
+# ---------------------------------------------------------------------------
+
+def test_write_and_gather_roundtrip():
+    spec = BlockKVSpec(num_layers=1, num_blocks=5, block_size=4,
+                       num_kv_heads=2, head_dim=4, dtype=jnp.float32)
+    layer = jnp.zeros(spec.shape[1:], jnp.float32)
+    rng = np.random.default_rng(0)
+    new = rng.normal(size=(2, 6, 2, 4)).astype(np.float32)   # 2 seqs, 6 toks
+    bt = np.array([[1, 2], [3, 4]], np.int32)
+    pos = np.broadcast_to(np.arange(6, dtype=np.int64), (2, 6)).copy()
+    slots = slots_from_table(bt, pos, 4)
+    out = write_slots(layer, jnp.asarray(new), jnp.asarray(slots))
+    view = gather_block_kv(out, jnp.asarray(bt))             # (2, 8, 2, 4)
+    np.testing.assert_allclose(np.asarray(view[:, :6]), new, rtol=1e-6)
+    assert np.all(np.asarray(view[:, 6:]) == 0)
+
+
+def test_negative_slots_dropped():
+    layer = jnp.ones((3, 2, 1, 2), jnp.float32)
+    new = jnp.full((1, 2, 1, 2), 7.0)
+    slots = jnp.array([[-1, 3]], jnp.int32)
+    out = np.asarray(write_slots(layer, new, slots)).reshape(6, 2)
+    assert out[3, 0] == 7.0
+    # slot -1 must NOT wrap to the last flat slot (regression: jax scatter
+    # wraps negatives; a padded write once clobbered another row's block)
+    untouched = [i for i in range(6) if i != 3]
+    assert (out[untouched] == 1.0).all()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: paged generate == contiguous generate
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cfg_pair():
+    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, head_dim=16, vocab_size=512,
+              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
+              tie_word_embeddings=False, torch_dtype="float32")
+    base = dict(batch_size=2, seq_len=64, dtype="float32",
+                enable_bucketing=False)
+    contig = LlamaInferenceConfig(TpuConfig(**base), **hf)
+    paged = LlamaInferenceConfig(
+        TpuConfig(**base, is_block_kv_layout=True, pa_block_size=8,
+                  is_prefix_caching=True), **hf)
+    return contig, paged
+
+
+def test_paged_matches_contiguous(cfg_pair):
+    contig_cfg, paged_cfg = cfg_pair
+    app_c = CausalLMApplication(None, contig_cfg, LlamaFamily)
+    app_c.init_random_weights(7).init_cache()
+    app_p = PagedCausalLMApplication(None, paged_cfg, LlamaFamily)
+    app_p.init_random_weights(7).init_cache()
+
+    ids = np.random.default_rng(0).integers(1, 512, size=(2, 11), dtype=np.int64)
+    mask = np.ones_like(ids); mask[0, 9:] = 0; ids[0, 9:] = 0
+    want = app_c.generate(ids, attention_mask=mask, max_new_tokens=8)
+    got = app_p.generate(ids, attention_mask=mask, max_new_tokens=8)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    assert got["cached_tokens"].sum() == 0
+
+    # --- prefix caching: same prompts again reuse full blocks and match ---
+    app_p.release()
+    got2 = app_p.generate(ids, attention_mask=mask, max_new_tokens=8)
+    assert got2["cached_tokens"][0] == 8     # 9-token row: one full block
+    assert got2["cached_tokens"][1] == 8     # 11-token row: one full block
+    np.testing.assert_array_equal(got2["generated"], want["generated"])
+    app_p.release()
+
+
+def test_chunked_prefill_matches(cfg_pair):
+    """Chunked prefill (fixed windows over the prompt, growing paged KV) must
+    be token-identical to one-shot prefill."""
+    from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+    contig_cfg, _ = cfg_pair
+    hf = {k: getattr(contig_cfg, k) for k in
+          ("model_type", "hidden_size", "intermediate_size", "num_hidden_layers",
+           "num_attention_heads", "num_key_value_heads", "head_dim",
+           "vocab_size", "rms_norm_eps", "rope_theta", "hidden_act",
+           "tie_word_embeddings")}
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=False, is_block_kv_layout=True,
+                     pa_block_size=8, is_chunked_prefill=True,
+                     chunked_prefill_config=ChunkedPrefillConfig(
+                         kernel_q_tile_size=8))
+    chunked_cfg = LlamaInferenceConfig(tcfg, **hf)
+    app_c = CausalLMApplication(None, contig_cfg, LlamaFamily)
+    app_c.init_random_weights(7).init_cache()
+    app_k = PagedCausalLMApplication(None, chunked_cfg, LlamaFamily)
+    app_k.init_random_weights(7).init_cache()
+    ids = np.random.default_rng(2).integers(1, 512, size=(2, 21), dtype=np.int64)
+    mask = np.ones_like(ids); mask[0, 17:] = 0; ids[0, 17:] = 0
+    want = app_c.generate(ids, attention_mask=mask, max_new_tokens=6)
+    got = app_k.generate(ids, attention_mask=mask, max_new_tokens=6)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    app_k.release()
+
+
+def test_chunked_intra_batch_prefix_sharing(cfg_pair):
+    """Regression: two IDENTICAL prompts in one chunked-prefill batch. Row 1's
+    prefix-cache hit on row 0's just-allocated blocks must not read slots row
+    0 hasn't written yet (later chunks)."""
+    from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+    contig_cfg, _ = cfg_pair
+    hf = {k: getattr(contig_cfg, k) for k in
+          ("model_type", "hidden_size", "intermediate_size", "num_hidden_layers",
+           "num_attention_heads", "num_key_value_heads", "head_dim",
+           "vocab_size", "rms_norm_eps", "rope_theta", "hidden_act",
+           "tie_word_embeddings")}
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=False, is_block_kv_layout=True,
+                     pa_block_size=8, is_prefix_caching=True,
+                     is_chunked_prefill=True,
+                     chunked_prefill_config=ChunkedPrefillConfig(
+                         kernel_q_tile_size=8))
+    app_k = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                     LlamaFamily)
+    app_k.init_random_weights(7).init_cache()
+    app_c = CausalLMApplication(None, contig_cfg, LlamaFamily)
+    app_c.init_random_weights(7).init_cache()
+    row = np.random.default_rng(3).integers(1, 512, size=(16,), dtype=np.int64)
+    ids = np.stack([row, row])                    # identical prompts
+    want = app_c.generate(ids, max_new_tokens=4)
+    got = app_k.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    app_k.release()
