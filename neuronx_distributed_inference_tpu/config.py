@@ -232,6 +232,9 @@ class TpuConfig:
     quantized_checkpoints_path: Optional[str] = None
     modules_to_not_convert: Optional[List[str]] = None
     kv_cache_quant: bool = False
+    # scaled-mode KV quantization: store x/scale (reference:
+    # kv_cache_manager.py:661-692); 1.0 = direct cast
+    kv_cache_scale: float = 1.0
 
     # --- kernels (reference: models/config.py:417-567 — ~25 enable flags) ---
     # None/False = XLA attention path (measured faster than the v1 Pallas

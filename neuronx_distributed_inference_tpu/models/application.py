@@ -82,16 +82,60 @@ class CausalLMApplication:
         path = model_path or self.model_path
         sd = ckpt.load_state_dict(path)
         host = self.family.convert_hf_state_dict(sd, self.spec)
-        shardings = model_base.param_shardings(self.spec, self.mesh)
-        self.params = ckpt.device_put_params(host, shardings,
-                                             dtype=self.spec.dtype)
+        self._put_params(host)
         return self
 
     def init_random_weights(self, seed: int = 0):
         """Synthetic weights (tiny-model tests / benches — reference:
         modules/checkpoint.py:202-287)."""
-        self.params = model_base.init_params(self.spec, jax.random.PRNGKey(seed),
-                                             self.mesh)
+        if self.spec.quant is None:
+            self.params = model_base.init_params(
+                self.spec, jax.random.PRNGKey(seed), self.mesh)
+        else:
+            host = jax.device_get(model_base.init_params(
+                self.spec, jax.random.PRNGKey(seed)))
+            self._put_params(host)
+        return self
+
+    def _put_params(self, host: Dict[str, Any]):
+        """Shard-on-load; quantize first when the config asks for it
+        (reference: application_base.py:746-799 quantize-and-save path)."""
+        from ..modules import quantization as quant
+        fp_shardings = model_base.param_shardings(self.spec, self.mesh)
+        if self.spec.quant is None:
+            self.params = ckpt.device_put_params(host, fp_shardings,
+                                                 dtype=self.spec.dtype)
+            return
+        host = jax.tree.map(
+            lambda x: (np.asarray(x).astype(self.spec.dtype)
+                       if np.issubdtype(np.asarray(x).dtype, np.floating)
+                       else np.asarray(x)), host)
+        qhost = quant.quantize_params(host, self.spec.quant)
+        shardings = quant.quantized_shardings(fp_shardings, qhost, self.mesh)
+        self.params = ckpt.device_put_params(qhost, shardings, dtype=None)
+
+    def save_quantized_state_dict(self, path: str):
+        """Quantize the loaded/initialized weights and save them flat
+        (reference: application_base.py:746-799
+        ``save_quantized_state_dict``). Reload with
+        :meth:`load_quantized_state_dict`."""
+        if self.spec.quant is None:
+            raise ValueError("config.tpu_config.quantized must be set")
+        if self.params is None:
+            raise RuntimeError("load_weights() first")
+        host = jax.device_get(self.params)
+        flat = _flatten_tree(host)
+        ckpt.save_state_dict_safetensors(
+            {k: np.asarray(v) for k, v in flat.items()}, path)
+        self.config.save(path + os.sep)
+
+    def load_quantized_state_dict(self, path: str):
+        sd = ckpt.load_state_dict(path)
+        host = _unflatten_tree(sd)
+        from ..modules import quantization as quant
+        fp_shardings = model_base.param_shardings(self.spec, self.mesh)
+        shardings = quant.quantized_shardings(fp_shardings, host, self.mesh)
+        self.params = ckpt.device_put_params(host, shardings, dtype=None)
         return self
 
     def init_cache(self):
@@ -340,6 +384,28 @@ class CausalLMApplication:
         """Clear KV cache between requests."""
         self.init_cache()
         return self
+
+
+def _flatten_tree(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_tree(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten_tree(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
 
 
 def _finalize_generation(input_ids: np.ndarray, collected, eos_ids,

@@ -43,6 +43,8 @@ from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
 from ..modules.moe import MoESpec, moe_block
+from ..modules.quantization import (QuantSpec, qlinear,
+                                    quant_spec_from_config)
 
 ACT_FNS = {
     "silu": jax.nn.silu,
@@ -92,6 +94,14 @@ class DecoderSpec:
     # (reference: modules/moe_v2.py; intermediate_size then refers to the
     # per-expert intermediate)
     moe: Optional[MoESpec] = None
+    # weight-only quantization (reference: models/config.py:216-241); the
+    # param tree then carries {"qweight","scale"} leaf-groups for the
+    # converted weights (modules/quantization.py)
+    quant: Optional[QuantSpec] = None
+    # scaled KV quantization: values are stored as x/kv_scale in kv_dtype and
+    # rescaled on read (reference: kv_cache_manager.py:636-692 scaled fp8
+    # mode; None = direct cast)
+    kv_scale: Optional[float] = None
 
     @property
     def scale(self) -> float:
@@ -225,9 +235,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     g = spec.gqa
     dtype = hidden.dtype
     h = rms_norm(hidden, layer_w["input_norm"], spec.rms_eps)
-    q = h @ layer_w["q_proj"]
-    k = h @ layer_w["k_proj"]
-    v = h @ layer_w["v_proj"]
+    q = qlinear(h, layer_w["q_proj"])
+    k = qlinear(h, layer_w["k_proj"])
+    v = qlinear(h, layer_w["v_proj"])
     if spec.qkv_bias:
         q = q + layer_w["q_bias"]
         k = k + layer_w["k_bias"]
@@ -243,12 +253,14 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 
     if phase == "paged":
         from ..modules import block_kv_cache as bkv
-        new_k = bkv.write_slots(k_cache, kv.quantize_kv(k, k_cache.dtype),
-                                slot_mapping)
-        new_v = bkv.write_slots(v_cache, kv.quantize_kv(v, v_cache.dtype),
-                                slot_mapping)
-        k_all = bkv.gather_block_kv(new_k, block_table).astype(dtype)
-        v_all = bkv.gather_block_kv(new_v, block_table).astype(dtype)
+        new_k = bkv.write_slots(
+            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale), slot_mapping)
+        new_v = bkv.write_slots(
+            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale), slot_mapping)
+        k_all = kv.dequantize_kv(bkv.gather_block_kv(new_k, block_table),
+                                 dtype, spec.kv_scale)
+        v_all = kv.dequantize_kv(bkv.gather_block_kv(new_v, block_table),
+                                 dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap)
     elif phase == "prefill":
@@ -267,25 +279,32 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         else:
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap)
-        new_k = kv.write_prefill(k_cache, kv.quantize_kv(k, k_cache.dtype), seq_ids)
-        new_v = kv.write_prefill(v_cache, kv.quantize_kv(v, v_cache.dtype), seq_ids)
+        new_k = kv.write_prefill(
+            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale), seq_ids)
+        new_v = kv.write_prefill(
+            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale), seq_ids)
     else:
-        new_k = kv.write_tokens(k_cache, kv.quantize_kv(k, k_cache.dtype),
-                                seq_ids, positions)
-        new_v = kv.write_tokens(v_cache, kv.quantize_kv(v, v_cache.dtype),
-                                seq_ids, positions)
+        new_k = kv.write_tokens(
+            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale),
+            seq_ids, positions)
+        new_v = kv.write_tokens(
+            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale),
+            seq_ids, positions)
         if identity_seq_ids and hidden.shape[0] == k_cache.shape[0]:
             # static guarantee that seq_ids == arange (no continuous
             # batching): skip the row-gather copy of the whole cache
-            k_all, v_all = new_k.astype(dtype), new_v.astype(dtype)
+            k_all = kv.dequantize_kv(new_k, dtype, spec.kv_scale)
+            v_all = kv.dequantize_kv(new_v, dtype, spec.kv_scale)
         else:
-            k_all = kv.gather_cache_rows(new_k, seq_ids).astype(dtype)
-            v_all = kv.gather_cache_rows(new_v, seq_ids).astype(dtype)
+            k_all = kv.dequantize_kv(kv.gather_cache_rows(new_k, seq_ids),
+                                     dtype, spec.kv_scale)
+            v_all = kv.dequantize_kv(kv.gather_cache_rows(new_v, seq_ids),
+                                     dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap)
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
-    h = attn_out @ layer_w["o_proj"]
+    h = qlinear(attn_out, layer_w["o_proj"])
     hidden = hidden + _shard(h, AXIS_DP, None, None)
 
     h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps)
@@ -293,9 +312,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         h = moe_block(spec.moe, h, layer_w)
     else:
         act = ACT_FNS[spec.act]
-        inter = act(h @ layer_w["gate_proj"]) * (h @ layer_w["up_proj"])
+        inter = act(qlinear(h, layer_w["gate_proj"])) * qlinear(h, layer_w["up_proj"])
         inter = _shard(inter, AXIS_DP, None, AXIS_MP)
-        h = inter @ layer_w["down_proj"]
+        h = qlinear(inter, layer_w["down_proj"])
     hidden = hidden + _shard(h, AXIS_DP, None, None)
     return hidden, new_k, new_v
 
@@ -544,6 +563,8 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         # attn_kernel_enabled until it beats XLA (reference keeps the same
         # dual-path structure, attention_base.py:985-1034)
         flash_prefill=bool(tcfg.attn_kernel_enabled),
+        quant=quant_spec_from_config(tcfg),
+        kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)
     return DecoderSpec(**kw)

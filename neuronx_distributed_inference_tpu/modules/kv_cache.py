@@ -72,8 +72,19 @@ def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None):
     return {"k": zeros(), "v": zeros()}
 
 
-def quantize_kv(x: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Direct-cast KV quantization (reference: kv_cache_manager.py:636-660)."""
+def quantize_kv(x: jnp.ndarray, dtype, scale: Optional[float] = None) -> jnp.ndarray:
+    """KV quantization on write (reference: kv_cache_manager.py:636-692):
+    direct-cast mode (scale=None) or scaled mode — store x/scale so the fp8
+    dynamic range covers the KV distribution."""
+    if scale is not None and scale != 1.0:
+        x = x.astype(jnp.float32) / scale
+    return x.astype(dtype)
+
+
+def dequantize_kv(x: jnp.ndarray, dtype, scale: Optional[float] = None) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv` on read."""
+    if scale is not None and scale != 1.0:
+        return (x.astype(jnp.float32) * scale).astype(dtype)
     return x.astype(dtype)
 
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from ..parallel.mesh import (AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint)
+from .quantization import dequantize, is_quantized_leaf, qeinsum, qlinear
 
 
 @dataclass(frozen=True)
@@ -111,10 +112,10 @@ def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     dt = x.dtype
     combine = combine_matrix(moe.num_experts, top_vals, top_idx)  # (B,T,E)
     # (B,T,E,I): expert axis sharded on ep, intermediate on tp
-    gate = jnp.einsum("bth,ehi->btei", x, wg)
-    up = jnp.einsum("bth,ehi->btei", x, wu)
+    gate = qeinsum("bth,ehi->btei", x, wg)
+    up = qeinsum("bth,ehi->btei", x, wu)
     inter = shard_constraint(act(gate) * up, AXIS_DP, None, AXIS_EP, AXIS_TP)
-    outs = jnp.einsum("btei,eih->bteh", inter, wd)
+    outs = qeinsum("btei,eih->bteh", inter, wd)
     # combine-weighted sum over E — psum over "ep" + "tp" partial sums
     y = jnp.einsum("bteh,bte->bth", outs.astype(jnp.float32), combine)
     return shard_constraint(y.astype(dt), AXIS_DP, None, None)
@@ -134,6 +135,10 @@ def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     k = moe.top_k
     act = _act_fn(moe.act)
     dt = x.dtype
+    # ragged_dot needs materialized fp expert weights; dequantize per call
+    # (prefill is compute-bound, the dequant is amortized over many tokens)
+    wg, wu, wd = (dequantize(w, dt) if is_quantized_leaf(w) else w
+                  for w in (wg, wu, wd))
 
     flat_x = x.reshape(b * t, h)
     flat_expert = top_idx.reshape(-1)                       # (N,) expert ids
@@ -166,7 +171,7 @@ def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any]
                 layer_w["expert_up"], layer_w["expert_down"])
     if moe.shared_intermediate > 0:
         act = _act_fn(moe.act)
-        s = act(x @ layer_w["shared_gate"]) * (x @ layer_w["shared_up"])
+        s = act(qlinear(x, layer_w["shared_gate"])) * qlinear(x, layer_w["shared_up"])
         s = shard_constraint(s, AXIS_DP, None, AXIS_MP)
-        y = y + s @ layer_w["shared_down"]
+        y = y + qlinear(s, layer_w["shared_down"])
     return y

@@ -1,0 +1,287 @@
+"""Weight quantization — TPU-native replacement for the reference's NxD
+quantization stack (reference: models/config.py:216-241 quantization knobs,
+model_wrapper.py:1477-1528 qconfig synthesis, application_base.py:746-799
+quantize-and-save; SURVEY §5 "quantization matrix": int8 per-tensor /
+per-channel, fp8 weights, fp8 KV direct-cast + scaled, MXFP4 compute).
+
+Design: weight-only quantization represented as a *pytree transform*. A
+quantized weight is a dict leaf-group
+
+    {"qweight": int8/fp8/uint8-packed, "scale": fp32[, "qscheme": meta]}
+
+produced host-side by :func:`quantize_params` (or loaded from a quantized
+checkpoint) and consumed inside the traced graph by :func:`qlinear` /
+:func:`dequantize`. Dequantization is expressed so XLA fuses it into the
+consuming matmul:
+
+  * per-channel / per-tensor int8 and fp8: scale factors out of the
+    contraction — compute ``(x @ q) * scale_out`` so the MXU sees an
+    int8→bf16 cast, never a materialized fp copy of the weight.
+  * MXFP4 (group-wise scales along the contraction dim): dequantize the
+    weight tile then matmul; packing is 2 fp4 values per uint8 with one
+    e8m0 scale per ``group_size`` input channels (OCP MX spec layout, as
+    used by gpt-oss checkpoints).
+
+The scheme strings intentionally match the reference's
+``quantization_type`` values (models/config.py:229): "per_tensor_symmetric",
+"per_channel_symmetric"; plus "fp8" and "mxfp4".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT8 = "int8"
+FP8 = "fp8"
+MXFP4 = "mxfp4"
+
+PER_TENSOR = "per_tensor_symmetric"
+PER_CHANNEL = "per_channel_symmetric"
+
+# weights eligible for quantization inside a decoder layer stack; the
+# reference's modules_to_not_convert (models/config.py:233) subtracts from
+# this set. Router weights stay fp32 always (routing decisions are
+# precision-sensitive — same choice the reference makes).
+DEFAULT_QUANT_MODULES = (
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj",
+    "expert_gate", "expert_up", "expert_down",
+    "shared_gate", "shared_up", "shared_down",
+)
+
+# e2m1 (fp4) value table per the OCP microscaling spec: sign x {0, .5, 1,
+# 1.5, 2, 3, 4, 6}
+_FP4_VALUES = np.array(
+    [0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0,
+     -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0], dtype=np.float32)
+
+
+@dataclass(frozen=True)
+class QuantSpec:
+    """Static quantization description (hashable; closed over by jit).
+
+    dtype: "int8" | "fp8" | "mxfp4"; scheme per reference quantization_type.
+    group_size only applies to mxfp4 (scale per group along the contraction
+    dim). modules_to_not_convert: weight names left in full precision.
+    """
+
+    dtype: str = INT8
+    scheme: str = PER_CHANNEL
+    group_size: int = 32
+    modules_to_not_convert: Tuple[str, ...] = ()
+
+    def converts(self, name: str) -> bool:
+        return (name in DEFAULT_QUANT_MODULES
+                and name not in self.modules_to_not_convert)
+
+
+def quant_spec_from_config(tpu_config) -> Optional[QuantSpec]:
+    """Resolve a QuantSpec from TpuConfig knobs
+    (reference: models/config.py:216-241)."""
+    if not getattr(tpu_config, "quantized", False):
+        return None
+    dtype = tpu_config.quantization_dtype
+    scheme = tpu_config.quantization_type
+    if dtype in ("f8e4m3", "float8_e4m3fn"):
+        dtype = FP8
+    skip = tuple(tpu_config.modules_to_not_convert or ())
+    return QuantSpec(dtype=dtype, scheme=scheme, modules_to_not_convert=skip)
+
+
+def is_quantized_leaf(w: Any) -> bool:
+    return isinstance(w, dict) and "qweight" in w
+
+
+# ---------------------------------------------------------------------------
+# host-side quantize (numpy) — reference: generate_quantized_state_dict
+# (application_base.py:772-792)
+# ---------------------------------------------------------------------------
+
+def _absmax_scale(w: np.ndarray, axis, qmax: float) -> np.ndarray:
+    amax = np.max(np.abs(w), axis=axis, keepdims=True)
+    return np.maximum(amax, 1e-8).astype(np.float32) / qmax
+
+
+def quantize_tensor(w: np.ndarray, spec: QuantSpec) -> Dict[str, np.ndarray]:
+    """Quantize one weight (..., in, out). Contraction dim is axis -2 (the
+    framework stores x@w layouts, family.py converts torch (out,in) on load).
+    """
+    w = np.asarray(w, dtype=np.float32)
+    # leading dims (layer stack L, experts E) are never reduced: "per tensor"
+    # means per (layer, expert) weight matrix, matching the reference's
+    # per-module qconfigs (model_wrapper.py:1477-1528)
+    if spec.dtype == INT8:
+        axis = ((w.ndim - 2, w.ndim - 1) if spec.scheme == PER_TENSOR
+                else (w.ndim - 2,))
+        scale = _absmax_scale(w, axis, 127.0)
+        q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        return {"qweight": q, "scale": scale}
+    if spec.dtype == FP8:
+        axis = ((w.ndim - 2, w.ndim - 1) if spec.scheme == PER_TENSOR
+                else (w.ndim - 2,))
+        scale = _absmax_scale(w, axis, 448.0)   # e4m3 max normal
+        q = (w / scale).astype(jnp.float8_e4m3fn)
+        return {"qweight": q, "scale": scale}
+    if spec.dtype == MXFP4:
+        return quantize_mxfp4(w, spec.group_size)
+    raise ValueError(f"unknown quantization dtype {spec.dtype!r}")
+
+
+def quantize_mxfp4(w: np.ndarray, group_size: int = 32) -> Dict[str, np.ndarray]:
+    """MXFP4: e2m1 values, one power-of-two (e8m0-style) scale per
+    ``group_size`` channels of the contraction dim (axis -2). Packed two
+    nibbles per uint8 along the contraction dim.
+
+    Layout: w (..., K, N) -> qweight uint8 (..., K//2, N) [low nibble = even
+    k, high nibble = odd k], scale fp32 (..., K//group, N).
+    """
+    *lead, K, N = w.shape
+    assert K % group_size == 0, (K, group_size)
+    g = w.reshape(*lead, K // group_size, group_size, N)
+    amax = np.max(np.abs(g), axis=-2, keepdims=True)
+    # power-of-two scale so amax maps into the fp4 range (max 6.0)
+    exp = np.ceil(np.log2(np.maximum(amax, 1e-30) / 6.0))
+    scale = np.exp2(exp).astype(np.float32)
+    scaled = g / scale
+    # nearest fp4 value per element: match magnitude, carry sign in bit 3
+    idx = np.abs(np.abs(scaled)[..., None] - _FP4_VALUES[:8]).argmin(axis=-1)
+    idx = idx.astype(np.uint8) + np.where(scaled < 0, 8, 0).astype(np.uint8)
+    idx = idx.reshape(*lead, K, N)
+    packed = (idx[..., 0::2, :] | (idx[..., 1::2, :] << 4)).astype(np.uint8)
+    return {"qweight": packed,
+            "scale": scale.reshape(*lead, K // group_size, N)}
+
+
+def _leaf_scheme(leaf: Dict[str, Any]) -> str:
+    # uint8 = packed fp4 nibbles; int8 / float8_e4m3fn identify themselves
+    dt = leaf["qweight"].dtype
+    if dt == jnp.uint8:
+        return MXFP4
+    return FP8 if dt == jnp.float8_e4m3fn else INT8
+
+
+def quantize_params(params: Dict[str, Any], spec: QuantSpec) -> Dict[str, Any]:
+    """Transform a param tree: replace eligible layer weights with quantized
+    leaf-groups. Works on host (numpy) arrays; run before device_put."""
+
+    def convert(tree):
+        out = {}
+        for name, v in tree.items():
+            if isinstance(v, dict) and not is_quantized_leaf(v):
+                out[name] = convert(v)
+            elif spec.converts(name) and not is_quantized_leaf(v):
+                out[name] = quantize_tensor(np.asarray(v), spec)
+            else:
+                out[name] = v
+        return out
+
+    return convert(params)
+
+
+# ---------------------------------------------------------------------------
+# in-graph dequant / matmul
+# ---------------------------------------------------------------------------
+
+def dequantize(leaf: Dict[str, Any], dtype=jnp.bfloat16) -> jnp.ndarray:
+    """Materialize the fp weight (mxfp4 path; int8/fp8 prefer qlinear)."""
+    q, scale = leaf["qweight"], leaf["scale"]
+    if _leaf_scheme(leaf) == MXFP4:
+        lut = jnp.asarray(_FP4_VALUES)
+        lo = lut[(q & 0x0F).astype(jnp.int32)]
+        hi = lut[(q >> 4).astype(jnp.int32)]
+        *lead, Kh, N = q.shape
+        K = Kh * 2
+        # byte j: low nibble = channel 2j, high = 2j+1; stacking on a new
+        # axis right after Kh then flattening interleaves them back
+        vals = jnp.stack([lo, hi], axis=-2)            # (*lead, Kh, 2, N)
+        vals = vals.reshape(*lead, K, N)
+        group = K // scale.shape[-2]                   # inferred group size
+        vals = vals.reshape(*lead, K // group, group, N)
+        vals = vals * scale[..., :, None, :]
+        return vals.reshape(*lead, K, N).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def qlinear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Linear that accepts fp arrays OR quantized leaf-groups.
+
+    int8/fp8 per-channel/per-tensor: scale commutes out of the contraction —
+    (x @ q) * scale_row keeps the weight stream int8 in HBM (the whole point:
+    decode is HBM-bandwidth-bound, int8 halves the weight bytes).
+    """
+    if not is_quantized_leaf(w):
+        return x @ w
+    scheme = _leaf_scheme(w)
+    if scheme == MXFP4:
+        return x @ dequantize(w, x.dtype)
+    q, scale = w["qweight"], w["scale"]
+    y = x @ q.astype(x.dtype)
+    # scale (..., 1, out) or scalar (...,) -> broadcast over (B, T, out)
+    s = scale[..., 0, :] if scale.ndim >= 2 else scale
+    return (y.astype(jnp.float32) * s).astype(x.dtype)
+
+
+def qeinsum(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """Einsum accepting quantized expert weights (dense all-experts MoE path).
+    Scale layouts follow quantize_tensor: contraction dim is the
+    second-to-last axis of w."""
+    if not is_quantized_leaf(w):
+        return jnp.einsum(pattern, x, w)
+    scheme = _leaf_scheme(w)
+    if scheme == MXFP4:
+        return jnp.einsum(pattern, x, dequantize(w, x.dtype))
+    q, scale = w["qweight"], w["scale"]
+    y = jnp.einsum(pattern, x, q.astype(x.dtype))
+    if scale.ndim >= 2:
+        # (..., 1, out): drop the contraction axis, broadcast to y's trailing
+        s = scale[..., 0, :]
+        # expert weights (E, 1, out): out dims of y are (..., E?, out) — the
+        # einsum puts expert axis before out for "btei"/"bteh" patterns
+        y = y.astype(jnp.float32) * s
+    else:
+        y = y.astype(jnp.float32) * scale
+    return y.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# sharding of quantized trees
+# ---------------------------------------------------------------------------
+
+def quantized_shardings(fp_shardings: Dict[str, Any], params: Dict[str, Any],
+                        mesh) -> Dict[str, Any]:
+    """Derive shardings for a quantized param tree from the fp ParamSpec
+    shardings: qweight inherits the weight's sharding; scale inherits it with
+    the contraction axis unsharded (its extent is 1 or K/group)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def walk(sh_tree, p_tree):
+        out = {}
+        for name, v in p_tree.items():
+            sh = sh_tree[name]
+            if is_quantized_leaf(v):
+                wspec = sh.spec
+                q_ndim = v["qweight"].ndim
+                entries = list(wspec) + [None] * (q_ndim - len(wspec))
+                # scale layout mirrors the weight with the contraction axis
+                # reduced; size-1 dims (per-tensor) can't carry a mesh axis
+                s_shape = v["scale"].shape
+                s_entries = entries[:q_ndim - 2] + [None, entries[q_ndim - 1]]
+                s_entries = [e if d > 1 else None
+                             for e, d in zip(s_entries, s_shape)]
+                out[name] = {
+                    "qweight": NamedSharding(mesh, P(*entries[:q_ndim])),
+                    "scale": NamedSharding(mesh, P(*s_entries)),
+                }
+            elif isinstance(v, dict):
+                out[name] = walk(sh, v)
+            else:
+                out[name] = sh
+        return out
+
+    return walk(fp_shardings, params)

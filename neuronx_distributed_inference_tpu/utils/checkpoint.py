@@ -59,8 +59,9 @@ def _load_one(path: str) -> Dict[str, np.ndarray]:
             for k in f.keys():
                 try:
                     out[k] = f.get_tensor(k)
-                except (TypeError, ValueError):
-                    # bf16 tensors: numpy lacks bfloat16 — round-trip via torch
+                except (TypeError, ValueError, AttributeError):
+                    # bf16/fp8 tensors: numpy lacks these dtypes (safetensors
+                    # raises AttributeError for fp8) — round-trip via torch
                     out[k] = _torch_tensor(path, k)
         return out
     import torch
@@ -76,9 +77,13 @@ def _torch_tensor(path: str, key: str) -> np.ndarray:
 
 def _to_numpy(t) -> np.ndarray:
     import torch
+    import ml_dtypes
     if t.dtype == torch.bfloat16:
-        import ml_dtypes
         return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    if t.dtype == torch.float8_e4m3fn:
+        return t.view(torch.uint8).numpy().view(ml_dtypes.float8_e4m3fn)
+    if t.dtype == torch.float8_e5m2:
+        return t.view(torch.uint8).numpy().view(ml_dtypes.float8_e5m2)
     return t.numpy()
 
 

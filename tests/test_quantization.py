@@ -1,0 +1,151 @@
+"""Quantization tests (reference test strategy: unit coverage of quantized
+kv-cache managers + per-model quantized config, SURVEY §4; quantization
+matrix in models/config.py:216-241)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, TpuConfig
+from neuronx_distributed_inference_tpu.modules import quantization as quant
+from neuronx_distributed_inference_tpu.modules.quantization import (
+    FP8, INT8, MXFP4, PER_CHANNEL, PER_TENSOR, QuantSpec, dequantize,
+    qeinsum, qlinear, quantize_params, quantize_tensor)
+
+from conftest import tiny_llama_hf_config
+
+
+def _rel_err(a, b):
+    return float(np.linalg.norm(a - b) / (np.linalg.norm(a) + 1e-9))
+
+
+@pytest.mark.parametrize("scheme", [PER_CHANNEL, PER_TENSOR])
+def test_int8_roundtrip(rng, scheme):
+    w = rng.normal(size=(4, 32, 48)).astype(np.float32)  # (L, in, out)
+    leaf = quantize_tensor(w, QuantSpec(INT8, scheme))
+    assert leaf["qweight"].dtype == np.int8
+    back = np.asarray(dequantize(leaf, jnp.float32))
+    assert _rel_err(w, back) < 0.02
+    # scale layout: per-layer (per-channel keeps out axis, per-tensor is 1x1)
+    assert leaf["scale"].shape[0] == 4
+
+
+def test_fp8_roundtrip(rng):
+    w = rng.normal(size=(32, 48)).astype(np.float32)
+    leaf = quantize_tensor(w, QuantSpec(FP8, PER_CHANNEL))
+    back = np.asarray(dequantize(leaf, jnp.float32))
+    assert _rel_err(w, back) < 0.08
+
+
+def test_mxfp4_roundtrip(rng):
+    w = rng.normal(size=(64, 16)).astype(np.float32)
+    leaf = quantize_tensor(w, QuantSpec(MXFP4, group_size=32))
+    assert leaf["qweight"].dtype == np.uint8
+    assert leaf["qweight"].shape == (32, 16)      # packed 2/byte on K
+    assert leaf["scale"].shape == (2, 16)         # K/group groups
+    back = np.asarray(dequantize(leaf, jnp.float32))
+    # fp4 is coarse: check strong correlation, not tight error
+    assert _rel_err(w, back) < 0.25
+    # exactly representable values survive exactly
+    w2 = np.array([[1.0, -3.0], [0.5, 6.0], [2.0, -0.5], [4.0, 1.5]],
+                  dtype=np.float32)
+    leaf2 = quantize_tensor(w2, QuantSpec(MXFP4, group_size=4))
+    assert np.allclose(np.asarray(dequantize(leaf2, jnp.float32)), w2)
+
+
+@pytest.mark.parametrize("dtype,tol", [(INT8, 0.02), (FP8, 0.07),
+                                       (MXFP4, 0.4)])
+def test_qlinear_matches_fp(rng, dtype, tol):
+    x = rng.normal(size=(2, 8, 64)).astype(np.float32)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    leaf = quantize_tensor(w, QuantSpec(dtype, PER_CHANNEL))
+    y = np.asarray(qlinear(jnp.asarray(x), leaf))
+    assert _rel_err(x @ w, y) < tol
+
+
+def test_qeinsum_expert_weights(rng):
+    x = rng.normal(size=(2, 4, 16)).astype(np.float32)
+    w = rng.normal(size=(4, 16, 8)).astype(np.float32)   # (E, H, I)
+    leaf = quantize_tensor(w, QuantSpec(INT8, PER_CHANNEL))
+    y = np.asarray(qeinsum("bth,ehi->btei", jnp.asarray(x), leaf))
+    ref = np.einsum("bth,ehi->btei", x, w)
+    assert _rel_err(ref, y) < 0.02
+
+
+def test_quantize_params_selective(rng):
+    params = {
+        "embed": rng.normal(size=(16, 8)).astype(np.float32),
+        "layers": {
+            "q_proj": rng.normal(size=(2, 8, 8)).astype(np.float32),
+            "input_norm": np.ones((2, 8), np.float32),
+            "router": rng.normal(size=(2, 8, 4)).astype(np.float32),
+        },
+    }
+    q = quantize_params(params, QuantSpec(INT8, PER_CHANNEL))
+    assert quant.is_quantized_leaf(q["layers"]["q_proj"])
+    assert not quant.is_quantized_leaf(q["layers"]["router"])   # router stays fp
+    assert q["embed"].dtype == np.float32                        # embed untouched
+    # modules_to_not_convert honored
+    q2 = quantize_params(params, QuantSpec(
+        INT8, PER_CHANNEL, modules_to_not_convert=("q_proj",)))
+    assert not quant.is_quantized_leaf(q2["layers"]["q_proj"])
+
+
+def _tiny_app(quant_kwargs, seq_len=64):
+    from neuronx_distributed_inference_tpu.models.application import \
+        CausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.parallel.mesh import (MeshConfig,
+                                                                 build_mesh)
+    tcfg = TpuConfig(batch_size=2, seq_len=seq_len, dtype="float32",
+                     enable_bucketing=False, **quant_kwargs)
+    icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+    mesh = build_mesh(MeshConfig(tp=1))
+    app = CausalLMApplication(None, icfg, LlamaFamily, mesh=mesh)
+    app.init_random_weights(seed=0)
+    app.init_cache()
+    return app
+
+
+def test_e2e_int8_generation_close_to_fp(rng):
+    """int8 weight quantization: generation runs end-to-end and logits stay
+    close to the fp baseline (reference accuracy gate: logit matching,
+    utils/accuracy.py)."""
+    prompts = rng.integers(0, 500, size=(2, 12)).astype(np.int32)
+    fp = _tiny_app({})
+    base = fp.generate(prompts, max_new_tokens=8, return_logits=False)
+    q = _tiny_app({"quantized": True, "quantization_dtype": "int8",
+                   "quantization_type": PER_CHANNEL})
+    assert q.spec.quant is not None
+    out = q.generate(prompts, max_new_tokens=8)
+    assert out["generated"].shape == base["generated"].shape
+    # random tiny nets amplify quant noise; token-level agreement of the
+    # first steps is the robust check
+    assert (out["generated"][:, 0] == base["generated"][:, 0]).all()
+
+
+def test_e2e_fp8_kv_scaled(rng):
+    """fp8 KV cache with scaled mode runs and produces finite logits."""
+    prompts = rng.integers(0, 500, size=(2, 12)).astype(np.int32)
+    app = _tiny_app({"kv_cache_dtype": "float8_e4m3fn", "kv_cache_quant": True,
+                     "kv_cache_scale": 2.0})
+    assert app.spec.kv_scale == 2.0
+    out = app.generate(prompts, max_new_tokens=4)
+    assert out["generated"].shape == (2, 4)
+    assert (out["generated"] >= 0).all()
+
+
+@pytest.mark.parametrize("qdtype", ["int8", "fp8"])
+def test_quantized_save_load_roundtrip(tmp_path, rng, qdtype):
+    app = _tiny_app({"quantized": True, "quantization_dtype": qdtype,
+                     "quantization_type": PER_CHANNEL})
+    prompts = rng.integers(0, 500, size=(2, 8)).astype(np.int32)
+    out1 = app.generate(prompts, max_new_tokens=4)
+    app.save_quantized_state_dict(str(tmp_path / "qckpt"))
+    app2 = _tiny_app({"quantized": True, "quantization_dtype": qdtype,
+                      "quantization_type": PER_CHANNEL})
+    app2.load_quantized_state_dict(str(tmp_path / "qckpt"))
+    out2 = app2.generate(prompts, max_new_tokens=4)
+    assert (out1["generated"] == out2["generated"]).all()
