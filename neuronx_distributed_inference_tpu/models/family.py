@@ -51,6 +51,9 @@ class DecoderFamily:
     config_cls: Type[InferenceConfig] = InferenceConfig
     hf_prefix = "model"
     spec_overrides: Dict[str, Any] = {}
+    # HF weight name feeding the pre-MLP norm ("post_norm" in the spec);
+    # sandwich-norm families (gemma3) point it at pre_feedforward_layernorm
+    post_norm_src = "post_attention_layernorm"
 
     # -- spec --
     @classmethod
@@ -104,9 +107,10 @@ class DecoderFamily:
             "v_proj": layer_stack(p + ".layers.{i}.self_attn.v_proj.weight", kv_t),
             "o_proj": layer_stack(p + ".layers.{i}.self_attn.o_proj.weight", o_t),
             "post_norm": layer_stack(
-                p + ".layers.{i}.post_attention_layernorm.weight", ident),
+                p + ".layers.{i}." + cls.post_norm_src + ".weight", ident),
         }
         layers.update(cls.convert_mlp_weights(get, layer_stack, spec))
+        layers.update(cls.convert_extra_layer_weights(get, layer_stack, spec))
         if spec.qkv_bias:
             def q_b(b):
                 return place_q_weight(b, g, D)
@@ -135,6 +139,12 @@ class DecoderFamily:
         if not spec.tie_word_embeddings:
             out["lm_head"] = np.ascontiguousarray(vpad(get("lm_head.weight")).T)
         return out
+
+    # -- extra per-layer weights hook (sandwich norms, sinks, …) --
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec: DecoderSpec
+                                    ) -> Dict[str, np.ndarray]:
+        return {}
 
     # -- MLP / MoE weight conversion hook --
     @classmethod

@@ -1,0 +1,2 @@
+from .modeling_gemma3 import (Gemma3Family, Gemma3InferenceConfig,
+                              TpuGemma3ForCausalLM)

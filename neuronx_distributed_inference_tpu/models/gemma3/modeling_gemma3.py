@@ -1,0 +1,97 @@
+"""Gemma3 family (reference: models/gemma3/modeling_gemma3.py
+``NeuronGemma3ForCausalLM`` — SURVEY §2.7: sliding-window model).
+
+Gemma3 deltas vs the Llama-shaped base, all expressed as DecoderSpec knobs
+(model_base.py) rather than a separate layer implementation:
+  * alternating local/global attention — ``layer_pattern`` from HF
+    ``layer_types`` (5 sliding : 1 full by default)
+  * dual RoPE — global layers use rope_theta (1e6, linear-scaled), local
+    layers use ``rope_local_base_freq`` (1e4) via ``local_rope``
+  * sandwich norms (post_attn_norm / post_ff_norm) + (1+w) zero-centered
+    RMSNorm (``norm_offset=1``)
+  * qk-norm over head_dim, query_pre_attn_scalar softmax scale,
+    sqrt(hidden) embedding scale, tied embeddings
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+from ...ops.rope import RopeConfig
+
+
+class Gemma3InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size",
+                "head_dim", "sliding_window"]
+
+    def get_text_config(self) -> "InferenceConfig":
+        # multimodal Gemma3 checkpoints nest the text config; text-only ones
+        # are flat (reference: models/config.py:946 get_text_config)
+        return self
+
+
+@register_family("gemma3", "gemma3_text")
+class Gemma3Family(DecoderFamily):
+    config_cls = Gemma3InferenceConfig
+    post_norm_src = "pre_feedforward_layernorm"
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        n_layers = config.num_hidden_layers
+        layer_types = getattr(config, "layer_types", None)
+        if layer_types is None:
+            pattern_n = getattr(config, "sliding_window_pattern", 6)
+            layer_types = ["sliding_attention" if (i + 1) % pattern_n else
+                           "full_attention" for i in range(n_layers)]
+        pattern = tuple(t == "sliding_attention" for t in layer_types)
+        local_rope = RopeConfig(
+            head_dim=config.head_dim,
+            rope_theta=float(getattr(config, "rope_local_base_freq", 10000.0)))
+        scalar = float(getattr(config, "query_pre_attn_scalar",
+                               config.head_dim))
+        return spec_from_config(
+            config, tp_degree,
+            sliding_window=int(config.sliding_window),
+            layer_pattern=pattern,
+            local_rope=local_rope,
+            sandwich_norm=True,
+            norm_offset=1.0,
+            qk_norm=True,
+            attn_scale=scalar ** -0.5,
+            embed_scale=math.sqrt(config.hidden_size),
+            logits_soft_cap=getattr(config, "final_logit_softcapping", None),
+            attn_soft_cap=getattr(config, "attn_logit_softcapping", None),
+            act=getattr(config, "hidden_activation", "gelu_pytorch_tanh"),
+            # HF omits default-True values from config.json
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             True)),
+        )
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec: DecoderSpec
+                                    ) -> Dict[str, np.ndarray]:
+        p = cls.hf_prefix
+
+        def ident(w):
+            return np.asarray(w)
+
+        return {
+            "post_attn_norm": layer_stack(
+                p + ".layers.{i}.post_attention_layernorm.weight", ident),
+            "post_ff_norm": layer_stack(
+                p + ".layers.{i}.post_feedforward_layernorm.weight", ident),
+        }
+
+
+def TpuGemma3ForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, Gemma3Family)

@@ -84,6 +84,20 @@ class DecoderSpec:
     attn_soft_cap: Optional[float] = None
     attn_scale: Optional[float] = None   # None => head_dim ** -0.5
     embed_scale: Optional[float] = None  # gemma multiplies embeddings
+    # --- per-layer attention variation (reference: gemma3 alternating
+    # local/global layers; gpt_oss alternating sliding/full — SURVEY §2.7).
+    # layer_pattern[i] True = layer i is LOCAL: sliding_window + local_rope.
+    # None = uniform (sliding_window, if set, applies to every layer).
+    layer_pattern: Optional[Tuple[bool, ...]] = None
+    local_rope: Optional[RopeConfig] = None   # rope for local layers
+    # gemma3 sandwich norms: post_attn_norm on attention output and
+    # post_ff_norm on MLP output, in addition to the two pre-norms
+    sandwich_norm: bool = False
+    # RMSNorm weight offset: 1.0 gives the gemma (1+w) convention
+    norm_offset: float = 0.0
+    # learned per-head softmax sinks (reference: modules/attention/sink.py,
+    # gpt-oss); adds a (L, Hq) "sink" param
+    attn_sink: bool = False
     dtype: Any = jnp.bfloat16
     kv_dtype: Any = jnp.bfloat16
     # flash-kernel strategy (reference analog: FlashAttentionStrategy,
@@ -168,6 +182,12 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
     if spec.qk_norm:
         layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
         layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
+    if spec.sandwich_norm:
+        layers["post_attn_norm"] = ParamSpec((L, H), P(), dt, "ones")
+        layers["post_ff_norm"] = ParamSpec((L, H), P(), dt, "ones")
+    if spec.attn_sink:
+        layers["sink"] = ParamSpec((L, spec.gqa.num_q_heads),
+                                   P(None, AXIS_MP), jnp.float32, "zeros")
     out: Dict[str, Any] = {
         "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_MP, None), dt),
         "layers": layers,
@@ -212,8 +232,29 @@ def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
     return x.reshape(b, t, n_heads, head_dim)
 
 
+def attn_inputs(spec: DecoderSpec, position_ids, make_mask) -> Dict[str, Any]:
+    """Bundle rope cos/sin + attention mask(s) for the layer stack.
+
+    ``make_mask(window)`` builds the phase-appropriate mask. With a
+    ``layer_pattern`` set (alternating local/global layers — reference:
+    gemma3 / gpt_oss families), both the local variant (sliding window +
+    local_rope) and the global variant are built once here; each scanned
+    layer selects by its is_local flag — one compiled layer body, no
+    per-layer branching (SURVEY §2.7)."""
+    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    ai: Dict[str, Any] = {"cos": cos, "sin": sin}
+    if spec.layer_pattern is None:
+        ai["mask"] = make_mask(spec.sliding_window)
+        return ai
+    ai["mask"] = make_mask(0)
+    cos_l, sin_l = rope_cos_sin(position_ids, spec.local_rope or spec.rope)
+    ai["cos_l"], ai["sin_l"] = cos_l, sin_l
+    ai["mask_l"] = make_mask(spec.sliding_window)
+    return ai
+
+
 def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
-                cos, sin, mask, seq_ids, positions, phase: str,
+                ai, is_local, seq_ids, positions, phase: str,
                 identity_seq_ids: bool = False,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None):
@@ -221,6 +262,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
     ``block_table`` set (phase "paged", reference:
     modules/kvcache/block_kv_cache_manager.py).
+
+    ai: attn_inputs() bundle; is_local: this layer's local/global flag
+    (traced scalar from the scan xs).
 
     phase "prefill": attend within the window only (no prior cache read),
       then write the window into the cache (reference CTE path).
@@ -234,7 +278,15 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     """
     g = spec.gqa
     dtype = hidden.dtype
-    h = rms_norm(hidden, layer_w["input_norm"], spec.rms_eps)
+    off = spec.norm_offset
+    if "cos_l" in ai:
+        cos = jnp.where(is_local, ai["cos_l"], ai["cos"])
+        sin = jnp.where(is_local, ai["sin_l"], ai["sin"])
+        mask = jnp.where(is_local, ai["mask_l"], ai["mask"])
+    else:
+        cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
+    sink = layer_w["sink"] if spec.attn_sink else None
+    h = rms_norm(hidden, layer_w["input_norm"], spec.rms_eps, off)
     q = qlinear(h, layer_w["q_proj"])
     k = qlinear(h, layer_w["k_proj"])
     v = qlinear(h, layer_w["v_proj"])
@@ -246,8 +298,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
     v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
     if spec.qk_norm:
-        q = rms_norm(q, layer_w["q_norm"], spec.rms_eps)
-        k = rms_norm(k, layer_w["k_norm"], spec.rms_eps)
+        q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+        k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
@@ -262,14 +314,16 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         v_all = kv.dequantize_kv(bkv.gather_block_kv(new_v, block_table),
                                  dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
-                                logits_soft_cap=spec.attn_soft_cap)
+                                logits_soft_cap=spec.attn_soft_cap, sink=sink)
     elif phase == "prefill":
         # flash kernel requirements beyond supports(): per-row positions must
         # be arange (the kernel rebuilds causality from array indices — an
-        # offset/chunked prefill must use the mask path), and tp must be 1
+        # offset/chunked prefill must use the mask path), tp must be 1
         # until the kernel is shard_map-wrapped (under GSPMD a bare
-        # pallas_call would be all-gathered and run replicated per chip)
+        # pallas_call would be all-gathered and run replicated per chip),
+        # and the window/sink must be uniform across layers (static kernel)
         if (spec.flash_prefill and arange_positions and spec.gqa.tp == 1
+                and spec.layer_pattern is None and not spec.attn_sink
                 and flash_attention.supports(
                     q.shape[1], spec.head_dim, has_sink=False, chunk=0)):
             attn_out = flash_attention.flash_attention(
@@ -278,7 +332,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                 interpret=jax.default_backend() != "tpu")
         else:
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
-                                    logits_soft_cap=spec.attn_soft_cap)
+                                    logits_soft_cap=spec.attn_soft_cap,
+                                    sink=sink)
         new_k = kv.write_prefill(
             k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale), seq_ids)
         new_v = kv.write_prefill(
@@ -301,13 +356,15 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
             v_all = kv.dequantize_kv(kv.gather_cache_rows(new_v, seq_ids),
                                      dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
-                                logits_soft_cap=spec.attn_soft_cap)
+                                logits_soft_cap=spec.attn_soft_cap, sink=sink)
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
+    if spec.sandwich_norm:
+        h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
     hidden = hidden + _shard(h, AXIS_DP, None, None)
 
-    h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps)
+    h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps, off)
     if spec.moe is not None:
         h = moe_block(spec.moe, h, layer_w)
     else:
@@ -315,11 +372,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         inter = act(qlinear(h, layer_w["gate_proj"])) * qlinear(h, layer_w["up_proj"])
         inter = _shard(inter, AXIS_DP, None, AXIS_MP)
         h = qlinear(inter, layer_w["down_proj"])
+    if spec.sandwich_norm:
+        h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
     hidden = hidden + _shard(h, AXIS_DP, None, None)
     return hidden, new_k, new_v
 
 
-def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
+def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                seq_ids, positions, phase: str,
                identity_seq_ids: bool = False,
                arange_positions: bool = False,
@@ -328,18 +387,20 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, cos, sin, mask,
 
     Replaces the reference's per-layer Python loop
     (models/model_base.py:1216-1469 get_model_output).
-    Returns (hidden, new_cache).
+    ai: attn_inputs() bundle. Returns (hidden, new_cache).
     """
+    is_local = jnp.asarray(spec.layer_pattern if spec.layer_pattern is not None
+                           else (False,) * spec.num_layers)
 
     def body(carry, xs):
-        layer_w, kc, vc = xs
-        h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, cos, sin, mask,
+        layer_w, kc, vc, loc = xs
+        h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, ai, loc,
                                 seq_ids, positions, phase, identity_seq_ids,
                                 arange_positions, slot_mapping, block_table)
         return h, (nk, nv)
 
     hidden, (new_k, new_v) = jax.lax.scan(
-        body, hidden, (params["layers"], cache["k"], cache["v"]))
+        body, hidden, (params["layers"], cache["k"], cache["v"], is_local))
     return hidden, {"k": new_k, "v": new_v}
 
 
@@ -355,7 +416,7 @@ def _embed(spec: DecoderSpec, params, input_ids):
 
 
 def _lm_head(spec: DecoderSpec, params, hidden):
-    h = rms_norm(hidden, params["final_norm"], spec.rms_eps)
+    h = rms_norm(hidden, params["final_norm"], spec.rms_eps, spec.norm_offset)
     w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     logits = (h @ w).astype(jnp.float32)
     if spec.logits_soft_cap:
@@ -372,15 +433,14 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
     Returns dict(tokens (B,), last_logits (B, V) [optional], cache).
     """
-    cos, sin = rope_cos_sin(position_ids, spec.rope)
-    mask = attn_ops.prefill_causal_mask(input_ids.shape[1], position_ids,
-                                        window=spec.sliding_window)
+    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.prefill_causal_mask(
+        input_ids.shape[1], position_ids, window=w))
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
     hidden = _embed(spec, params, input_ids)
     # context_encoding_step always feeds arange positions per row (the host
     # shim builds them); chunked/offset prefill variants must pass False
-    hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
+    hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
                                    seq_ids, position_ids, "prefill",
                                    arange_positions=True)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
@@ -403,12 +463,11 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
     input_ids (B, T) with T = 1 (or speculation window).
     """
-    cos, sin = rope_cos_sin(position_ids, spec.rope)
     cache_len = cache["k"].shape[2]
-    mask = attn_ops.decode_mask(position_ids, cache_len,
-                                window=spec.sliding_window)
+    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
+        position_ids, cache_len, window=w))
     hidden = _embed(spec, params, input_ids)
-    hidden, new_cache = run_layers(spec, params, cache, hidden, cos, sin, mask,
+    hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
                                    seq_ids, position_ids, "decode",
                                    identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
@@ -427,13 +486,12 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     scoring all candidate tokens, model_base.py:2617-2642). Within-step
     causality falls out of the cache-write-then-attend order plus the
     position mask."""
-    cos, sin = rope_cos_sin(position_ids, spec.rope)
     cache_len = cache["k"].shape[2]
-    mask = attn_ops.decode_mask(position_ids, cache_len,
-                                window=spec.sliding_window)
+    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
+        position_ids, cache_len, window=w))
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache = run_layers(
-        spec, params, cache, hidden, cos, sin, mask, seq_ids, position_ids,
+        spec, params, cache, hidden, ai, seq_ids, position_ids,
         "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
     return {"logits_all": logits[..., :spec.vocab_size], "cache": new_cache}
@@ -456,12 +514,12 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     block_table (B, max_blocks); last_idx (B,) index into T of the token whose
     logits are sampled. Cache layout (L, N_blocks, Bs, Hkv, D).
     """
-    cos, sin = rope_cos_sin(position_ids, spec.rope)
     kv_len = block_table.shape[1] * cache["k"].shape[2]
-    mask = attn_ops.decode_mask(position_ids, kv_len, window=spec.sliding_window)
+    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
+        position_ids, kv_len, window=w))
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache = run_layers(
-        spec, params, cache, hidden, cos, sin, mask, None, position_ids,
+        spec, params, cache, hidden, ai, None, position_ids,
         "paged", slot_mapping=slot_mapping, block_table=block_table)
     idx = last_idx[:, None, None].astype(jnp.int32)
     last_h = jnp.take_along_axis(hidden, idx, axis=1)
