@@ -3,6 +3,7 @@
 from . import family  # noqa: F401
 from .llama import modeling_llama  # noqa: F401
 from .gemma3 import modeling_gemma3  # noqa: F401
+from .gpt_oss import modeling_gpt_oss  # noqa: F401
 from .mistral import modeling_mistral  # noqa: F401
 from .mixtral import modeling_mixtral  # noqa: F401
 from .qwen2 import modeling_qwen2  # noqa: F401

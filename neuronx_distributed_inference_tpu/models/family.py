@@ -121,6 +121,9 @@ class DecoderFamily:
             layers["q_bias"] = layer_stack(p + ".layers.{i}.self_attn.q_proj.bias", q_b)
             layers["k_bias"] = layer_stack(p + ".layers.{i}.self_attn.k_proj.bias", kv_b)
             layers["v_bias"] = layer_stack(p + ".layers.{i}.self_attn.v_proj.bias", kv_b)
+        if spec.o_bias:
+            layers["o_bias"] = layer_stack(
+                p + ".layers.{i}.self_attn.o_proj.bias", ident)
         if spec.qk_norm:
             layers["q_norm"] = layer_stack(p + ".layers.{i}.self_attn.q_norm.weight", ident)
             layers["k_norm"] = layer_stack(p + ".layers.{i}.self_attn.k_norm.weight", ident)

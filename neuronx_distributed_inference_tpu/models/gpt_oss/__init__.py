@@ -1,0 +1,2 @@
+from .modeling_gpt_oss import (GptOssFamily, GptOssInferenceConfig,
+                               TpuGptOssForCausalLM)

@@ -1,0 +1,139 @@
+"""GPT-OSS family (reference: models/gpt_oss/ — SURVEY §2.7: MXFP4 MoE,
+learned sinks, alternating attention, mx layout transform; 2034 LoC).
+
+Deltas vs the base decoder, all expressed as spec knobs:
+  * learned per-head attention sinks (``attn_sink``; reference:
+    modules/attention/sink.py) — extra softmax-denominator column
+  * alternating sliding/full attention via ``layer_pattern`` (1:1 ratio)
+  * YaRN rope (ops/rope.py yarn path with attention-factor cos/sin scale)
+  * MoE with router bias IN the logits, clamped-swiglu experts with
+    per-expert biases (moe.glu_style="oss_clamp")
+  * qkv + o projection biases
+  * MXFP4 expert weights: loads either the HF bf16 checkpoint (optionally
+    re-quantizing to our packed mxfp4 when ``quantized=True,
+    quantization_dtype="mxfp4"``) or the native gpt-oss blocks+scales
+    layout (``*_blocks`` / ``*_scales`` tensors, decoded by
+    quantization.dequant_oai_mxfp4_blocks)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules.moe import MoESpec
+from ...modules.quantization import dequant_oai_mxfp4_blocks
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+
+
+class GptOssInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size",
+                "num_local_experts", "num_experts_per_tok", "sliding_window"]
+
+
+@register_family("gpt_oss")
+class GptOssFamily(DecoderFamily):
+    config_cls = GptOssInferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        n_layers = config.num_hidden_layers
+        layer_types = getattr(config, "layer_types", None)
+        if layer_types is None:
+            layer_types = ["sliding_attention" if (i + 1) % 2 else
+                           "full_attention" for i in range(n_layers)]
+        pattern = tuple(t == "sliding_attention" for t in layer_types)
+        moe = MoESpec(
+            num_experts=config.num_local_experts,
+            top_k=config.num_experts_per_tok,
+            intermediate_size=config.intermediate_size,
+            normalize_topk=False,
+            pre_softmax_topk=True,       # topk on logits, softmax over the k
+            has_router_bias=True,
+            router_bias_mode="logits",
+            expert_bias=True,
+            glu_style="oss_clamp",
+        )
+        return spec_from_config(
+            config, tp_degree,
+            sliding_window=int(config.sliding_window),
+            layer_pattern=pattern,
+            attn_sink=True,
+            qkv_bias=bool(getattr(config, "attention_bias", True)),
+            o_bias=bool(getattr(config, "attention_bias", True)),
+            moe=moe,
+        )
+
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec: DecoderSpec
+                            ) -> Dict[str, np.ndarray]:
+        """gpt-oss expert layout: fused gate_up_proj (E, H, 2I) with gate/up
+        INTERLEAVED on the last dim (gate = ::2, up = 1::2), plus per-expert
+        biases; stored either as bf16 tensors or as MXFP4 blocks+scales."""
+        p = cls.hf_prefix
+        L = spec.num_layers
+
+        def expert_tensor(i: int, name: str) -> np.ndarray:
+            base = f"{p}.layers.{i}.mlp.experts.{name}"
+            try:
+                return np.asarray(get(base)).astype(np.float32)
+            except KeyError:
+                # native mxfp4 checkpoint layout: <name>_blocks + _scales,
+                # value axis LAST (E, rows, K/32, 16) -> (E, rows, K)
+                blocks = np.asarray(get(base + "_blocks"))
+                scales = np.asarray(get(base + "_scales"))
+                deq = dequant_oai_mxfp4_blocks(blocks, scales)
+                # stored row-major (E, out_rows, K): transpose to (E, K, out)
+                return np.ascontiguousarray(np.swapaxes(deq, -1, -2))
+
+        gate, up, down = [], [], []
+        gate_b, up_b, down_b = [], [], []
+        routers, router_biases = [], []
+        for i in range(L):
+            gu = expert_tensor(i, "gate_up_proj")            # (E, H, 2I)
+            gate.append(np.ascontiguousarray(gu[..., 0::2]))
+            up.append(np.ascontiguousarray(gu[..., 1::2]))
+            down.append(expert_tensor(i, "down_proj"))       # (E, I, H)
+            gub = np.asarray(get(f"{p}.layers.{i}.mlp.experts.gate_up_proj_bias"))
+            gate_b.append(np.ascontiguousarray(gub[..., 0::2]))
+            up_b.append(np.ascontiguousarray(gub[..., 1::2]))
+            down_b.append(np.asarray(
+                get(f"{p}.layers.{i}.mlp.experts.down_proj_bias")))
+            routers.append(np.ascontiguousarray(np.asarray(
+                get(f"{p}.layers.{i}.mlp.router.weight")).T.astype(np.float32)))
+            router_biases.append(np.asarray(
+                get(f"{p}.layers.{i}.mlp.router.bias")).astype(np.float32))
+        return {
+            "router": np.stack(routers),
+            "router_bias": np.stack(router_biases),
+            "expert_gate": np.stack(gate),
+            "expert_up": np.stack(up),
+            "expert_down": np.stack(down),
+            "expert_gate_bias": np.stack(gate_b),
+            "expert_up_bias": np.stack(up_b),
+            "expert_down_bias": np.stack(down_b),
+        }
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec: DecoderSpec
+                                    ) -> Dict[str, np.ndarray]:
+        from ...parallel.layers import place_q_weight
+        p = cls.hf_prefix
+
+        def sink_t(s):
+            # per-q-head param: place into padded slots like a q bias
+            return place_q_weight(np.asarray(s).astype(np.float32), spec.gqa,
+                                  1)
+
+        return {"sink": layer_stack(p + ".layers.{i}.self_attn.sinks", sink_t)}
+
+
+def TpuGptOssForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, GptOssFamily)

@@ -168,6 +168,13 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         })
         if m.has_router_bias:
             layers["router_bias"] = ParamSpec((L, E), P(), jnp.float32, "zeros")
+        if m.expert_bias:
+            layers["expert_gate_bias"] = ParamSpec(
+                (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
+            layers["expert_up_bias"] = ParamSpec(
+                (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
+            layers["expert_down_bias"] = ParamSpec(
+                (L, E, H), P(None, AXIS_EP, None), dt, "zeros")
         if m.shared_intermediate > 0:
             Is = m.shared_intermediate
             layers.update({
@@ -179,6 +186,9 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP), dt, "zeros")
         layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
         layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+    if spec.o_bias:
+        # row-parallel bias: replicated, added after the psum'd projection
+        layers["o_bias"] = ParamSpec((L, H), P(), dt, "zeros")
     if spec.qk_norm:
         layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
         layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
@@ -360,6 +370,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
+    if spec.o_bias:
+        h = h + layer_w["o_bias"]
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
     hidden = hidden + _shard(h, AXIS_DP, None, None)
@@ -586,6 +598,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     gqa = resolve_gqa_sharding(n_q, n_kv, tp)
     rope_scaling = getattr(config, "rope_scaling", None) or {}
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+    attention_factor = rope_scaling.get("attention_factor")
     rope = RopeConfig(
         head_dim=head_dim,
         rope_theta=float(getattr(config, "rope_theta", 10000.0)),
@@ -594,8 +607,16 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         scaling_factor=float(rope_scaling.get("factor", 1.0)),
         low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
         high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
-        original_max_position=int(rope_scaling.get(
-            "original_max_position_embeddings", 8192)),
+        original_max_position=int(
+            rope_scaling.get("original_max_position_embeddings")
+            or getattr(config, "max_position_embeddings", 8192)),
+        beta_fast=float(rope_scaling.get("beta_fast") or 32.0),
+        beta_slow=float(rope_scaling.get("beta_slow") or 1.0),
+        mscale=float(rope_scaling.get("mscale") or 0.0),
+        mscale_all_dim=float(rope_scaling.get("mscale_all_dim") or 0.0),
+        attention_factor=(float(attention_factor)
+                          if attention_factor is not None else None),
+        truncate=bool(rope_scaling.get("truncate", True)),
     )
     vocab = config.vocab_size
     kw = dict(

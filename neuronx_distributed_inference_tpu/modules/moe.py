@@ -54,6 +54,18 @@ class MoESpec:
     # bias added to router scores for expert selection only (DeepSeek-V3
     # e_score_correction_bias); affinity weights still use raw scores
     has_router_bias: bool = False
+    # "select": bias affects only which experts win (deepseek);
+    # "logits": bias is part of the logits — affects affinities too (gpt-oss
+    # router = linear with bias, topk, softmax over the k biased logits)
+    router_bias_mode: str = "select"
+    # per-expert projection biases (gpt-oss gate_up/down biases)
+    expert_bias: bool = False
+    # GLU form: "gated" = act(gate)*up; "oss_clamp" = gpt-oss clamped swiglu
+    # glu = gate*sigmoid(alpha*gate) with gate<=limit, |up|<=limit,
+    # out = (up+1)*glu
+    glu_style: str = "gated"
+    glu_alpha: float = 1.702
+    glu_limit: float = 7.0
     # TOTAL-token-count (B*T) threshold at or below which the dense
     # all-experts path is used; above it the ragged sorted-grouped-matmul
     # path runs. Decode (B*1 tokens) stays dense up to batch 64 by default.
@@ -75,6 +87,9 @@ def route(moe: MoESpec, h: jnp.ndarray, router_w: jnp.ndarray,
     MoENeuronConfig (normalize_top_k_affinities, routed_scaling_factor).
     """
     logits = h.astype(jnp.float32) @ router_w.astype(jnp.float32)  # (B,T,E)
+    if router_bias is not None and moe.router_bias_mode == "logits":
+        logits = logits + router_bias
+        router_bias = None
     if moe.router_act == "sigmoid":
         scores = jax.nn.sigmoid(logits)
     elif moe.pre_softmax_topk:
@@ -103,19 +118,31 @@ def combine_matrix(num_experts: int, top_vals: jnp.ndarray,
         top_idx].add(top_vals)
 
 
+def _glu(moe: MoESpec, gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
+    if moe.glu_style == "oss_clamp":
+        gate = jnp.minimum(gate, moe.glu_limit)
+        up = jnp.clip(up, -moe.glu_limit, moe.glu_limit)
+        return (up + 1.0) * (gate * jax.nn.sigmoid(gate * moe.glu_alpha))
+    return _act_fn(moe.act)(gate) * up
+
+
 def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
                   top_idx: jnp.ndarray, wg: jnp.ndarray, wu: jnp.ndarray,
-                  wd: jnp.ndarray) -> jnp.ndarray:
+                  wd: jnp.ndarray, bg=None, bu=None, bd=None) -> jnp.ndarray:
     """All-experts dense compute (reference: moe_token_gen all-experts decode
-    kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H)."""
-    act = _act_fn(moe.act)
+    kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H); b* optional (E,·) biases."""
     dt = x.dtype
     combine = combine_matrix(moe.num_experts, top_vals, top_idx)  # (B,T,E)
     # (B,T,E,I): expert axis sharded on ep, intermediate on tp
     gate = qeinsum("bth,ehi->btei", x, wg)
     up = qeinsum("bth,ehi->btei", x, wu)
-    inter = shard_constraint(act(gate) * up, AXIS_DP, None, AXIS_EP, AXIS_TP)
+    if bg is not None:
+        gate = gate + bg
+        up = up + bu
+    inter = shard_constraint(_glu(moe, gate, up), AXIS_DP, None, AXIS_EP, AXIS_TP)
     outs = qeinsum("btei,eih->bteh", inter, wd)
+    if bd is not None:
+        outs = outs + bd
     # combine-weighted sum over E — psum over "ep" + "tp" partial sums
     y = jnp.einsum("bteh,bte->bth", outs.astype(jnp.float32), combine)
     return shard_constraint(y.astype(dt), AXIS_DP, None, None)
@@ -123,7 +150,7 @@ def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
 
 def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
                    top_idx: jnp.ndarray, wg: jnp.ndarray, wu: jnp.ndarray,
-                   wd: jnp.ndarray) -> jnp.ndarray:
+                   wd: jnp.ndarray, bg=None, bu=None, bd=None) -> jnp.ndarray:
     """Dropless grouped-matmul path: sort token copies by expert, run
     ``jax.lax.ragged_dot`` per projection, unsort and combine.
 
@@ -133,7 +160,6 @@ def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     """
     b, t, h = x.shape
     k = moe.top_k
-    act = _act_fn(moe.act)
     dt = x.dtype
     # ragged_dot needs materialized fp expert weights; dequantize per call
     # (prefill is compute-bound, the dequant is amortized over many tokens)
@@ -146,14 +172,20 @@ def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
 
     order = jnp.argsort(flat_expert)                        # stable
     inv = jnp.argsort(order)
+    sorted_expert = flat_expert[order]
     sorted_tokens = flat_x[order // k]                      # (N, H)
     group_sizes = jnp.bincount(flat_expert, length=moe.num_experts
                                ).astype(jnp.int32)
 
     gate = jax.lax.ragged_dot(sorted_tokens, wg, group_sizes)
     up = jax.lax.ragged_dot(sorted_tokens, wu, group_sizes)
-    inter = act(gate) * up                                  # (N, I)
+    if bg is not None:
+        gate = gate + bg[sorted_expert]
+        up = up + bu[sorted_expert]
+    inter = _glu(moe, gate, up)                             # (N, I)
     outs = jax.lax.ragged_dot(inter, wd, group_sizes)       # (N, H)
+    if bd is not None:
+        outs = outs + bd[sorted_expert]
 
     outs = outs[inv].astype(jnp.float32) * flat_weight[:, None]
     y = outs.reshape(b * t, k, h).sum(axis=1).reshape(b, t, h)
@@ -167,8 +199,11 @@ def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any]
     top_vals, top_idx = route(moe, x, layer_w["router"], router_bias)
     experts = (experts_dense if x.shape[0] * x.shape[1] <= moe.dense_max_tokens
                else experts_ragged)
+    biases = ((layer_w["expert_gate_bias"], layer_w["expert_up_bias"],
+               layer_w["expert_down_bias"]) if moe.expert_bias
+              else (None, None, None))
     y = experts(moe, x, top_vals, top_idx, layer_w["expert_gate"],
-                layer_w["expert_up"], layer_w["expert_down"])
+                layer_w["expert_up"], layer_w["expert_down"], *biases)
     if moe.shared_intermediate > 0:
         act = _act_fn(moe.act)
         s = act(qlinear(x, layer_w["shared_gate"])) * qlinear(x, layer_w["shared_up"])

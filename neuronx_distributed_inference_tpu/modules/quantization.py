@@ -184,6 +184,25 @@ def quantize_params(params: Dict[str, Any], spec: QuantSpec) -> Dict[str, Any]:
     return convert(params)
 
 
+def dequant_oai_mxfp4_blocks(blocks: np.ndarray, scales: np.ndarray
+                             ) -> np.ndarray:
+    """Decode the gpt-oss checkpoint MXFP4 layout (reference: the mx layout
+    transform in models/gpt_oss/, SURVEY §2.7) to fp32.
+
+    blocks: uint8 (..., rows, n_groups, group_bytes) — each byte packs two
+    fp4 values, LOW nibble first; scales: uint8 (..., rows, n_groups) e8m0
+    exponents biased by 127. Returns (..., rows, n_groups*group_bytes*2).
+    """
+    blocks = np.asarray(blocks)
+    scales = np.asarray(scales).astype(np.int32) - 127
+    lut = _FP4_VALUES
+    lo = lut[(blocks & 0x0F).astype(np.int32)]
+    hi = lut[(blocks >> 4).astype(np.int32)]
+    vals = np.stack([lo, hi], axis=-1).reshape(*blocks.shape[:-1], -1)
+    return (vals * np.exp2(scales)[..., None]).reshape(
+        *blocks.shape[:-2], -1).astype(np.float32)
+
+
 # ---------------------------------------------------------------------------
 # in-graph dequant / matmul
 # ---------------------------------------------------------------------------

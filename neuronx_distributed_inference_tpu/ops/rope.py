@@ -26,11 +26,14 @@ class RopeConfig:
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position: int = 8192
-    # yarn params (deepseek)
+    # yarn params (deepseek / gpt-oss; reference: deepseek rope_util +
+    # HF _compute_yarn_parameters semantics)
     beta_fast: float = 32.0
     beta_slow: float = 1.0
     mscale: float = 1.0
     mscale_all_dim: float = 0.0
+    attention_factor: Optional[float] = None  # cos/sin multiplier; None=derive
+    truncate: bool = True
 
     @property
     def dim(self) -> int:
@@ -42,7 +45,44 @@ def _base_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
     return 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
 
 
-SUPPORTED_SCALING = (None, "default", "linear", "llama3")
+SUPPORTED_SCALING = (None, "default", "linear", "llama3", "yarn")
+
+
+def yarn_attention_factor(cfg: RopeConfig) -> float:
+    """Post-scale on cos/sin (YaRN attention temperature; HF
+    _compute_yarn_parameters semantics)."""
+    if cfg.attention_factor is not None:
+        return float(cfg.attention_factor)
+
+    def get_mscale(scale: float, m: float = 1.0) -> float:
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    if cfg.mscale and cfg.mscale_all_dim:
+        return get_mscale(cfg.scaling_factor, cfg.mscale) / get_mscale(
+            cfg.scaling_factor, cfg.mscale_all_dim)
+    return get_mscale(cfg.scaling_factor)
+
+
+def _yarn_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
+    d = cfg.dim
+    pos_freqs = cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    inv_extrap = 1.0 / pos_freqs
+    inv_interp = 1.0 / (cfg.scaling_factor * pos_freqs)
+
+    def corr_dim(n_rot: float) -> float:
+        return (d * math.log(cfg.original_max_position / (n_rot * 2 * math.pi))
+                ) / (2 * math.log(cfg.rope_theta))
+
+    low, high = corr_dim(cfg.beta_fast), corr_dim(cfg.beta_slow)
+    if cfg.truncate:
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, d - 1)
+    if low == high:
+        high += 0.001
+    ramp = jnp.clip((jnp.arange(d // 2, dtype=jnp.float32) - low)
+                    / (high - low), 0, 1)
+    extrap_factor = 1.0 - ramp
+    return inv_interp * (1 - extrap_factor) + inv_extrap * extrap_factor
 
 
 def compute_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
@@ -50,6 +90,8 @@ def compute_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
         raise NotImplementedError(
             f"rope scaling type {cfg.scaling_type!r} not implemented yet "
             f"(supported: {SUPPORTED_SCALING})")
+    if cfg.scaling_type == "yarn":
+        return _yarn_inv_freq(cfg)
     inv_freq = _base_inv_freq(cfg)
     if cfg.scaling_type == "linear":
         inv_freq = inv_freq / cfg.scaling_factor
@@ -72,7 +114,11 @@ def rope_cos_sin(position_ids: jnp.ndarray, cfg: RopeConfig
     """(B, S) int positions -> cos/sin of shape (B, S, dim/2), fp32."""
     inv_freq = compute_inv_freq(cfg)
     angles = position_ids.astype(jnp.float32)[..., None] * inv_freq  # (B,S,d/2)
-    return jnp.cos(angles), jnp.sin(angles)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if cfg.scaling_type == "yarn":
+        f = yarn_attention_factor(cfg)
+        cos, sin = cos * f, sin * f
+    return cos, sin
 
 
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
