@@ -2,6 +2,8 @@
 
 from . import family  # noqa: F401
 from .llama import modeling_llama  # noqa: F401
+from .dbrx import modeling_dbrx  # noqa: F401
+from .deepseek import modeling_deepseek  # noqa: F401
 from .gemma3 import modeling_gemma3  # noqa: F401
 from .gpt_oss import modeling_gpt_oss  # noqa: F401
 from .mistral import modeling_mistral  # noqa: F401

@@ -147,6 +147,8 @@ class CausalLMApplication:
             num_kv_heads=self.spec.gqa.num_kv_heads,
             head_dim=self.spec.head_dim,
             dtype=self.spec.kv_dtype,
+            v_head_dim=(self.spec.v_head_dim
+                        if self.spec.v_head_dim != self.spec.head_dim else None),
         )
         self.cache = init_cache(spec, self.mesh)
         return self

@@ -1,0 +1,1 @@
+from .modeling_dbrx import DbrxFamily, DbrxInferenceConfig, TpuDbrxForCausalLM

@@ -1,0 +1,133 @@
+"""DBRX family (reference: models/dbrx/modeling_dbrx.py
+``NeuronDbrxForCausalLM`` — SURVEY §2.7: MoE, 308 LoC).
+
+DBRX deltas: bias-free LayerNorm (not RMSNorm), fused Wqkv with clip_qkv
+clamping, 16-expert MoE with fused expert tensors (w1/v1/w2), softmax-then-
+topk router with optional L1 weight normalization."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules.moe import MoESpec
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+from ...parallel.layers import place_q_weight, replicate_kv_weight
+
+
+class DbrxInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["d_model", "n_heads", "n_layers", "vocab_size"]
+
+    def add_derived_config(self):
+        # map DBRX's naming onto the HF-standard attributes the base
+        # spec resolution expects (reference: dbrx setup_attr_for_model)
+        if hasattr(self, "d_model"):
+            self.hidden_size = self.d_model
+            self.num_attention_heads = self.n_heads
+            self.num_hidden_layers = self.n_layers
+            attn = getattr(self, "attn_config", {}) or {}
+            if not isinstance(attn, dict):
+                attn = attn.__dict__
+            self.num_key_value_heads = attn.get("kv_n_heads", self.n_heads)
+            self.rope_theta = attn.get("rope_theta", 10000.0)
+            self.clip_qkv = attn.get("clip_qkv")
+            ffn = getattr(self, "ffn_config", {}) or {}
+            if not isinstance(ffn, dict):
+                ffn = ffn.__dict__
+            self.intermediate_size = ffn.get("ffn_hidden_size", 4 * self.d_model)
+            self.moe_num_experts = ffn.get("moe_num_experts", 16)
+            self.moe_top_k = ffn.get("moe_top_k", 4)
+            self.moe_normalize_expert_weights = ffn.get(
+                "moe_normalize_expert_weights", 1)
+
+
+@register_family("dbrx")
+class DbrxFamily(DecoderFamily):
+    config_cls = DbrxInferenceConfig
+    hf_prefix = "transformer"
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        moe = MoESpec(
+            num_experts=config.moe_num_experts,
+            top_k=config.moe_top_k,
+            intermediate_size=config.intermediate_size,
+            # moe_normalize_expert_weights=1 is an L1 normalization of the
+            # top-k weights — same as sum-normalize for positive softmax vals
+            normalize_topk=bool(config.moe_normalize_expert_weights),
+        )
+        return spec_from_config(
+            config, tp_degree,
+            moe=moe,
+            norm_type="layernorm",
+            qkv_clip=(float(config.clip_qkv)
+                      if getattr(config, "clip_qkv", None) else None),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray], spec: DecoderSpec
+                              ) -> Dict[str, Any]:
+        p = cls.hf_prefix
+        L = spec.num_layers
+        g = spec.gqa
+        D = spec.head_dim
+        E, I = spec.moe.num_experts, spec.moe.intermediate_size
+        H = spec.hidden_size
+
+        def get(name):
+            if name in sd:
+                return np.asarray(sd[name])
+            raise KeyError(f"missing checkpoint tensor {name}")
+
+        def layer(i: int) -> Dict[str, np.ndarray]:
+            base = f"{p}.blocks.{i}"
+            wqkv = get(f"{base}.norm_attn_norm.attn.Wqkv.weight")  # (out, H)
+            nq = g.orig_q_heads * D
+            nkv = g.orig_kv_heads * D
+            qw, kw, vw = (wqkv[:nq], wqkv[nq:nq + nkv],
+                          wqkv[nq + nkv:nq + 2 * nkv])
+            # experts fused (E*I, H) for w1/v1 and (E*I, H) for w2
+            w1 = get(f"{base}.ffn.experts.mlp.w1").reshape(E, I, H)
+            v1 = get(f"{base}.ffn.experts.mlp.v1").reshape(E, I, H)
+            w2 = get(f"{base}.ffn.experts.mlp.w2").reshape(E, I, H)
+            return {
+                "input_norm": get(f"{base}.norm_attn_norm.norm_1.weight"),
+                "post_norm": get(f"{base}.norm_attn_norm.norm_2.weight"),
+                "q_proj": place_q_weight(np.ascontiguousarray(qw.T), g, D, -1),
+                "k_proj": replicate_kv_weight(np.ascontiguousarray(kw.T), g, D, -1),
+                "v_proj": replicate_kv_weight(np.ascontiguousarray(vw.T), g, D, -1),
+                "o_proj": place_q_weight(np.ascontiguousarray(
+                    get(f"{base}.norm_attn_norm.attn.out_proj.weight").T),
+                    g, D, 0),
+                "router": np.ascontiguousarray(
+                    get(f"{base}.ffn.router.layer.weight").T).astype(np.float32),
+                "expert_gate": np.ascontiguousarray(np.swapaxes(w1, 1, 2)),
+                "expert_up": np.ascontiguousarray(np.swapaxes(v1, 1, 2)),
+                "expert_down": np.ascontiguousarray(w2),  # (E, I, H) already
+            }
+
+        layers = [layer(i) for i in range(L)]
+        stacked = {k: np.stack([d[k] for d in layers]) for k in layers[0]}
+
+        def vpad(w):
+            if w.shape[0] < spec.padded_vocab:
+                w = np.pad(w, [(0, spec.padded_vocab - w.shape[0])] +
+                           [(0, 0)] * (w.ndim - 1))
+            return w
+
+        return {
+            "embed": vpad(get(p + ".wte.weight")),
+            "layers": stacked,
+            "final_norm": get(p + ".norm_f.weight"),
+            "lm_head": np.ascontiguousarray(vpad(get("lm_head.weight")).T),
+        }
+
+
+def TpuDbrxForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, DbrxFamily)

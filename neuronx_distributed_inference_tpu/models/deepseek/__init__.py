@@ -1,0 +1,2 @@
+from .modeling_deepseek import (DeepseekFamily, DeepseekInferenceConfig,
+                                TpuDeepseekForCausalLM)

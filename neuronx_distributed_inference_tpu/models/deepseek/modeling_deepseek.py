@@ -1,0 +1,184 @@
+"""DeepSeek-V2/V3 family (reference: models/deepseek/modeling_deepseek.py
+``DeepseekV3*`` — SURVEY §2.7: MLA attention, custom rope_util, 493 LoC).
+
+Covered deltas:
+  * MLA (multi-head latent attention): q-lora + kv-lora compression with a
+    shared rope head (model_base._mla_qkv); K dim = nope+rope, V dim =
+    v_head_dim
+  * yarn rope with mscale attention factor; softmax scale *= mscale(all_dim)^2
+  * sigmoid router with e_score_correction_bias (selection only),
+    group-limited greedy routing (n_group/topk_group), routed_scaling_factor
+  * mixed stacks: first_k_dense_replace dense layers then MoE layers with
+    shared experts
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules.moe import MoESpec
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, MLASpec, spec_from_config
+from ...parallel.layers import ParamSpec
+
+
+class DeepseekInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "vocab_size", "kv_lora_rank", "qk_nope_head_dim",
+                "qk_rope_head_dim", "v_head_dim"]
+
+
+@register_family("deepseek_v3", "deepseek_v2")
+class DeepseekFamily(DecoderFamily):
+    config_cls = DeepseekInferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        mla = MLASpec(
+            kv_lora_rank=config.kv_lora_rank,
+            qk_nope_head_dim=config.qk_nope_head_dim,
+            qk_rope_head_dim=config.qk_rope_head_dim,
+            v_head_dim=config.v_head_dim,
+            q_lora_rank=getattr(config, "q_lora_rank", None),
+        )
+        scale = mla.qk_head_dim ** -0.5
+        rope_scaling = getattr(config, "rope_scaling", None) or {}
+        mscale_all_dim = rope_scaling.get("mscale_all_dim", 0) or 0
+        if mscale_all_dim:
+            f = float(rope_scaling["factor"])
+            m = (1.0 if f <= 1 else
+                 0.1 * mscale_all_dim * math.log(f) + 1.0)
+            scale = scale * m * m
+        moe = None
+        first_dense = 0
+        if getattr(config, "n_routed_experts", None):
+            moe = MoESpec(
+                num_experts=config.n_routed_experts,
+                top_k=config.num_experts_per_tok,
+                intermediate_size=config.moe_intermediate_size,
+                normalize_topk=bool(getattr(config, "norm_topk_prob", True)),
+                routed_scaling=float(getattr(config, "routed_scaling_factor",
+                                             1.0)),
+                router_act="sigmoid",
+                has_router_bias=True,          # e_score_correction_bias
+                router_bias_mode="select",
+                shared_intermediate=(config.moe_intermediate_size
+                                     * getattr(config, "n_shared_experts", 0)),
+                n_group=int(getattr(config, "n_group", 1) or 1),
+                topk_group=int(getattr(config, "topk_group", 1) or 1),
+            )
+            first_dense = int(getattr(config, "first_k_dense_replace", 0))
+        spec = spec_from_config(
+            config, tp_degree,
+            mla=mla,
+            moe=moe,
+            first_dense=first_dense,
+            head_dim=mla.qk_head_dim,
+            attn_scale=scale,
+            rope_interleaved=bool(getattr(config, "rope_interleave", True)),
+        )
+        # rope operates on the dedicated rope head only
+        import dataclasses
+        return dataclasses.replace(
+            spec, rope=dataclasses.replace(spec.rope,
+                                           head_dim=mla.qk_rope_head_dim))
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray], spec: DecoderSpec
+                              ) -> Dict[str, Any]:
+        p = cls.hf_prefix
+        L = spec.num_layers
+        nd = spec.first_dense if spec.moe is not None else L
+
+        def get(name):
+            if name in sd:
+                return np.asarray(sd[name])
+            raise KeyError(f"missing checkpoint tensor {name}")
+
+        def t(w):
+            return np.ascontiguousarray(np.asarray(w).T)
+
+        def ident(w):
+            return np.asarray(w)
+
+        def attn_layer(i: int) -> Dict[str, np.ndarray]:
+            base = f"{p}.layers.{i}.self_attn"
+            out = {
+                "input_norm": ident(get(f"{p}.layers.{i}.input_layernorm.weight")),
+                "post_norm": ident(get(
+                    f"{p}.layers.{i}.post_attention_layernorm.weight")),
+                "kv_a_proj": t(get(f"{base}.kv_a_proj_with_mqa.weight")),
+                "kv_a_norm": ident(get(f"{base}.kv_a_layernorm.weight")),
+                "kv_b_proj": t(get(f"{base}.kv_b_proj.weight")),
+                "o_proj": t(get(f"{base}.o_proj.weight")),
+            }
+            if spec.mla.q_lora_rank:
+                out["q_a_proj"] = t(get(f"{base}.q_a_proj.weight"))
+                out["q_a_norm"] = ident(get(f"{base}.q_a_layernorm.weight"))
+                out["q_b_proj"] = t(get(f"{base}.q_b_proj.weight"))
+            else:
+                out["q_proj"] = t(get(f"{base}.q_proj.weight"))
+            return out
+
+        def dense_layer(i: int) -> Dict[str, np.ndarray]:
+            out = attn_layer(i)
+            for k, n in (("gate_proj", "gate_proj"), ("up_proj", "up_proj"),
+                         ("down_proj", "down_proj")):
+                out[k] = t(get(f"{p}.layers.{i}.mlp.{n}.weight"))
+            return out
+
+        def moe_layer(i: int) -> Dict[str, np.ndarray]:
+            out = attn_layer(i)
+            E = spec.moe.num_experts
+            out["router"] = t(get(f"{p}.layers.{i}.mlp.gate.weight")).astype(
+                np.float32)
+            out["router_bias"] = ident(get(
+                f"{p}.layers.{i}.mlp.gate.e_score_correction_bias")).astype(
+                np.float32)
+            for key, name in (("expert_gate", "gate_proj"),
+                              ("expert_up", "up_proj"),
+                              ("expert_down", "down_proj")):
+                out[key] = np.stack([
+                    t(get(f"{p}.layers.{i}.mlp.experts.{e}.{name}.weight"))
+                    for e in range(E)])
+            for key, name in (("shared_gate", "gate_proj"),
+                              ("shared_up", "up_proj"),
+                              ("shared_down", "down_proj")):
+                out[key] = t(get(
+                    f"{p}.layers.{i}.mlp.shared_experts.{name}.weight"))
+            return out
+
+        def stack(dicts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+            return {k: np.stack([d[k] for d in dicts]) for k in dicts[0]}
+
+        def vpad(w):
+            if w.shape[0] < spec.padded_vocab:
+                w = np.pad(w, [(0, spec.padded_vocab - w.shape[0])] +
+                           [(0, 0)] * (w.ndim - 1))
+            return w
+
+        out: Dict[str, Any] = {
+            "embed": vpad(get(p + ".embed_tokens.weight")),
+            "final_norm": ident(get(p + ".norm.weight")),
+        }
+        if spec.moe is not None and spec.first_dense > 0:
+            out["layers"] = stack([dense_layer(i) for i in range(nd)])
+            out["moe_layers"] = stack([moe_layer(i) for i in range(nd, L)])
+        elif spec.moe is not None:
+            out["layers"] = stack([moe_layer(i) for i in range(L)])
+        else:
+            out["layers"] = stack([dense_layer(i) for i in range(L)])
+        if not spec.tie_word_embeddings:
+            out["lm_head"] = np.ascontiguousarray(vpad(get("lm_head.weight")).T)
+        return out
+
+
+def TpuDeepseekForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, DeepseekFamily)

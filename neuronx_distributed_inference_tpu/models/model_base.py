@@ -33,7 +33,7 @@ from ..config import InferenceConfig, TpuConfig
 from ..ops import attention as attn_ops
 from ..ops import flash_attention
 from ..ops import sampling as sampling_ops
-from ..ops.normalization import rms_norm
+from ..ops.normalization import layer_norm, rms_norm
 from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
 from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
                                expert_column_parallel, expert_row_parallel,
@@ -52,6 +52,26 @@ ACT_FNS = {
     "gelu_new": partial(jax.nn.gelu, approximate=True),
     "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
 }
+
+
+@dataclass(frozen=True)
+class MLASpec:
+    """Multi-head Latent Attention geometry (reference: models/deepseek/
+    modeling_deepseek.py MLA attention — SURVEY §2.7).
+
+    KV is compressed to ``kv_lora_rank`` + a shared rope head; Q optionally
+    through ``q_lora_rank``. K heads are [nope | rope], V heads are
+    ``v_head_dim`` wide."""
+
+    kv_lora_rank: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    q_lora_rank: Optional[int] = None
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
 
 
 @dataclass(frozen=True)
@@ -108,6 +128,17 @@ class DecoderSpec:
     # (reference: modules/moe_v2.py; intermediate_size then refers to the
     # per-expert intermediate)
     moe: Optional[MoESpec] = None
+    # MLA attention (deepseek); head_dim then = mla.qk_head_dim
+    mla: Optional[MLASpec] = None
+    # leading dense-MLP layers before the MoE stack (deepseek
+    # first_k_dense_replace); only meaningful with moe set
+    first_dense: int = 0
+    # "rms" | "layernorm" (dbrx uses bias-free LayerNorm)
+    norm_type: str = "rms"
+    # clamp q/k/v projections to ±qkv_clip (dbrx clip_qkv)
+    qkv_clip: Optional[float] = None
+    # interleaved (GPT-NeoX pair) rope convention (deepseek rope_interleave)
+    rope_interleaved: bool = False
     # weight-only quantization (reference: models/config.py:216-241); the
     # param tree then carries {"qweight","scale"} leaf-groups for the
     # converted weights (modules/quantization.py)
@@ -129,6 +160,10 @@ class DecoderSpec:
     def kv_size(self) -> int:
         return self.gqa.num_kv_heads * self.head_dim
 
+    @property
+    def v_head_dim(self) -> int:
+        return self.mla.v_head_dim if self.mla is not None else self.head_dim
+
 
 def pad_vocab(vocab: int, tp: int, multiple: int = 128) -> int:
     m = max(tp, 1) * multiple
@@ -140,69 +175,120 @@ def pad_vocab(vocab: int, tp: int, multiple: int = 128) -> int:
 # module tree built in each model's init_model.
 # ---------------------------------------------------------------------------
 
-def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
-    L, H, I = spec.num_layers, spec.hidden_size, spec.intermediate_size
+def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
+    H = spec.hidden_size
     dt = spec.dtype
     layers: Dict[str, ParamSpec] = {
         "input_norm": ParamSpec((L, H), P(), dt, "ones"),
-        "q_proj": column_parallel(H, spec.q_size, dt, True, L),
-        "k_proj": column_parallel(H, spec.kv_size, dt, True, L),
-        "v_proj": column_parallel(H, spec.kv_size, dt, True, L),
-        "o_proj": row_parallel(spec.q_size, H, dt, True, L),
         "post_norm": ParamSpec((L, H), P(), dt, "ones"),
     }
-    if spec.moe is None:
-        layers.update({
-            "gate_proj": column_parallel(H, I, dt, True, L),
-            "up_proj": column_parallel(H, I, dt, True, L),
-            "down_proj": row_parallel(I, H, dt, True, L),
-        })
+    if spec.mla is not None:
+        m = spec.mla
+        nh = spec.gqa.num_q_heads
+        if m.q_lora_rank:
+            layers["q_a_proj"] = ParamSpec((L, H, m.q_lora_rank), P(), dt)
+            layers["q_a_norm"] = ParamSpec((L, m.q_lora_rank), P(), dt, "ones")
+            layers["q_b_proj"] = column_parallel(
+                m.q_lora_rank, nh * m.qk_head_dim, dt, True, L)
+        else:
+            layers["q_proj"] = column_parallel(H, nh * m.qk_head_dim, dt, True, L)
+        layers["kv_a_proj"] = ParamSpec(
+            (L, H, m.kv_lora_rank + m.qk_rope_head_dim), P(), dt)
+        layers["kv_a_norm"] = ParamSpec((L, m.kv_lora_rank), P(), dt, "ones")
+        layers["kv_b_proj"] = column_parallel(
+            m.kv_lora_rank, nh * (m.qk_nope_head_dim + m.v_head_dim), dt, True, L)
+        layers["o_proj"] = row_parallel(nh * m.v_head_dim, H, dt, True, L)
     else:
-        m = spec.moe
-        E, Ie = m.num_experts, m.intermediate_size
         layers.update({
-            "router": ParamSpec((L, H, E), P(), jnp.float32),
-            "expert_gate": expert_column_parallel(E, H, Ie, dt, True, L),
-            "expert_up": expert_column_parallel(E, H, Ie, dt, True, L),
-            "expert_down": expert_row_parallel(E, Ie, H, dt, True, L),
+            "q_proj": column_parallel(H, spec.q_size, dt, True, L),
+            "k_proj": column_parallel(H, spec.kv_size, dt, True, L),
+            "v_proj": column_parallel(H, spec.kv_size, dt, True, L),
+            "o_proj": row_parallel(spec.q_size, H, dt, True, L),
         })
-        if m.has_router_bias:
-            layers["router_bias"] = ParamSpec((L, E), P(), jnp.float32, "zeros")
-        if m.expert_bias:
-            layers["expert_gate_bias"] = ParamSpec(
-                (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
-            layers["expert_up_bias"] = ParamSpec(
-                (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
-            layers["expert_down_bias"] = ParamSpec(
-                (L, E, H), P(None, AXIS_EP, None), dt, "zeros")
-        if m.shared_intermediate > 0:
-            Is = m.shared_intermediate
-            layers.update({
-                "shared_gate": column_parallel(H, Is, dt, True, L),
-                "shared_up": column_parallel(H, Is, dt, True, L),
-                "shared_down": row_parallel(Is, H, dt, True, L),
-            })
-    if spec.qkv_bias:
-        layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP), dt, "zeros")
-        layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
-        layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+        if spec.qkv_bias:
+            layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP), dt, "zeros")
+            layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+            layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+        if spec.qk_norm:
+            layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
+            layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
     if spec.o_bias:
         # row-parallel bias: replicated, added after the psum'd projection
         layers["o_bias"] = ParamSpec((L, H), P(), dt, "zeros")
-    if spec.qk_norm:
-        layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
-        layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
     if spec.sandwich_norm:
         layers["post_attn_norm"] = ParamSpec((L, H), P(), dt, "ones")
         layers["post_ff_norm"] = ParamSpec((L, H), P(), dt, "ones")
     if spec.attn_sink:
         layers["sink"] = ParamSpec((L, spec.gqa.num_q_heads),
                                    P(None, AXIS_MP), jnp.float32, "zeros")
+    return layers
+
+
+def _dense_mlp_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
+    H, I = spec.hidden_size, spec.intermediate_size
+    dt = spec.dtype
+    return {
+        "gate_proj": column_parallel(H, I, dt, True, L),
+        "up_proj": column_parallel(H, I, dt, True, L),
+        "down_proj": row_parallel(I, H, dt, True, L),
+    }
+
+
+def _moe_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
+    m = spec.moe
+    H, dt = spec.hidden_size, spec.dtype
+    E, Ie = m.num_experts, m.intermediate_size
+    layers: Dict[str, ParamSpec] = {
+        "router": ParamSpec((L, H, E), P(), jnp.float32),
+        "expert_gate": expert_column_parallel(E, H, Ie, dt, True, L),
+        "expert_up": expert_column_parallel(E, H, Ie, dt, True, L),
+        "expert_down": expert_row_parallel(E, Ie, H, dt, True, L),
+    }
+    if m.has_router_bias:
+        layers["router_bias"] = ParamSpec((L, E), P(), jnp.float32, "zeros")
+    if m.expert_bias:
+        layers["expert_gate_bias"] = ParamSpec(
+            (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
+        layers["expert_up_bias"] = ParamSpec(
+            (L, E, Ie), P(None, AXIS_EP, AXIS_TP), dt, "zeros")
+        layers["expert_down_bias"] = ParamSpec(
+            (L, E, H), P(None, AXIS_EP, None), dt, "zeros")
+    if m.shared_intermediate > 0:
+        Is = m.shared_intermediate
+        layers.update({
+            "shared_gate": column_parallel(H, Is, dt, True, L),
+            "shared_up": column_parallel(H, Is, dt, True, L),
+            "shared_down": row_parallel(Is, H, dt, True, L),
+        })
+    return layers
+
+
+def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
+    """Shapes + shardings of the full param tree.
+
+    Uniform models: one "layers" stack of num_layers. Mixed dense/MoE models
+    (deepseek first_k_dense_replace): "layers" = the leading first_dense
+    dense layers, "moe_layers" = the trailing MoE layers — two lax.scan
+    stacks in run_layers."""
+    L, H = spec.num_layers, spec.hidden_size
+    dt = spec.dtype
     out: Dict[str, Any] = {
         "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_MP, None), dt),
-        "layers": layers,
         "final_norm": ParamSpec((H,), P(), dt, "ones"),
     }
+    if spec.moe is not None and spec.first_dense > 0:
+        n_dense, n_moe = spec.first_dense, L - spec.first_dense
+        dense = _attn_param_specs(spec, n_dense)
+        dense.update(_dense_mlp_param_specs(spec, n_dense))
+        moe = _attn_param_specs(spec, n_moe)
+        moe.update(_moe_param_specs(spec, n_moe))
+        out["layers"] = dense
+        out["moe_layers"] = moe
+    else:
+        layers = _attn_param_specs(spec, L)
+        layers.update(_dense_mlp_param_specs(spec, L) if spec.moe is None
+                      else _moe_param_specs(spec, L))
+        out["layers"] = layers
     if not spec.tie_word_embeddings:
         out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_MP), dt)
     return out
@@ -242,6 +328,48 @@ def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
     return x.reshape(b, t, n_heads, head_dim)
 
 
+def _norm(spec: DecoderSpec, x, w):
+    """Pre/post-block norm: RMSNorm (default, with optional gemma offset) or
+    bias-free LayerNorm (dbrx)."""
+    if spec.norm_type == "layernorm":
+        return layer_norm(x, w, None, spec.rms_eps)
+    return rms_norm(x, w, spec.rms_eps, spec.norm_offset)
+
+
+def _mla_qkv(spec: DecoderSpec, h, layer_w, cos, sin):
+    """Multi-head Latent Attention projections (reference: models/deepseek/
+    modeling_deepseek.py MLA): Q through optional q-lora, KV through the
+    compressed latent + shared rope head. Returns q/k (B,T,Hq,qk_head_dim),
+    v (B,T,Hq,v_head_dim)."""
+    m = spec.mla
+    nh = spec.gqa.num_q_heads
+    b, t, _ = h.shape
+    if m.q_lora_rank:
+        qa = rms_norm(qlinear(h, layer_w["q_a_proj"]), layer_w["q_a_norm"],
+                      spec.rms_eps)
+        q = qlinear(qa, layer_w["q_b_proj"])
+    else:
+        q = qlinear(h, layer_w["q_proj"])
+    q = _shard(q.reshape(b, t, nh, m.qk_head_dim), AXIS_DP, None, AXIS_MP, None)
+    q_nope, q_rot = q[..., :m.qk_nope_head_dim], q[..., m.qk_nope_head_dim:]
+
+    ckv = qlinear(h, layer_w["kv_a_proj"])                  # (B,T,r+rope)
+    k_pass, k_rot = ckv[..., :m.kv_lora_rank], ckv[..., m.kv_lora_rank:]
+    kv = qlinear(rms_norm(k_pass, layer_w["kv_a_norm"], spec.rms_eps),
+                 layer_w["kv_b_proj"])
+    kv = _shard(kv.reshape(b, t, nh, m.qk_nope_head_dim + m.v_head_dim),
+                AXIS_DP, None, AXIS_MP, None)
+    k_nope, v = kv[..., :m.qk_nope_head_dim], kv[..., m.qk_nope_head_dim:]
+
+    q_rot = apply_rope(q_rot, cos, sin, interleaved=spec.rope_interleaved)
+    k_rot = apply_rope(k_rot[:, :, None, :], cos, sin,
+                       interleaved=spec.rope_interleaved)   # (B,T,1,rope)
+    k_rot = jnp.broadcast_to(k_rot, (b, t, nh, m.qk_rope_head_dim))
+    q = jnp.concatenate([q_nope, q_rot], axis=-1)
+    k = jnp.concatenate([k_nope, k_rot], axis=-1)
+    return q, k, v
+
+
 def attn_inputs(spec: DecoderSpec, position_ids, make_mask) -> Dict[str, Any]:
     """Bundle rope cos/sin + attention mask(s) for the layer stack.
 
@@ -267,7 +395,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                 ai, is_local, seq_ids, positions, phase: str,
                 identity_seq_ids: bool = False,
                 arange_positions: bool = False,
-                slot_mapping=None, block_table=None):
+                slot_mapping=None, block_table=None,
+                mlp_kind: Optional[str] = None):
     """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D) — or, in
     the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
     ``block_table`` set (phase "paged", reference:
@@ -289,6 +418,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     g = spec.gqa
     dtype = hidden.dtype
     off = spec.norm_offset
+    if mlp_kind is None:
+        mlp_kind = "dense" if spec.moe is None else "moe"
     if "cos_l" in ai:
         cos = jnp.where(is_local, ai["cos_l"], ai["cos"])
         sin = jnp.where(is_local, ai["sin_l"], ai["sin"])
@@ -296,22 +427,29 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     else:
         cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
     sink = layer_w["sink"] if spec.attn_sink else None
-    h = rms_norm(hidden, layer_w["input_norm"], spec.rms_eps, off)
-    q = qlinear(h, layer_w["q_proj"])
-    k = qlinear(h, layer_w["k_proj"])
-    v = qlinear(h, layer_w["v_proj"])
-    if spec.qkv_bias:
-        q = q + layer_w["q_bias"]
-        k = k + layer_w["k_bias"]
-        v = v + layer_w["v_bias"]
-    q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
-    k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
-    v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
-    if spec.qk_norm:
-        q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
-        k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    h = _norm(spec, hidden, layer_w["input_norm"])
+    if spec.mla is not None:
+        q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
+    else:
+        q = qlinear(h, layer_w["q_proj"])
+        k = qlinear(h, layer_w["k_proj"])
+        v = qlinear(h, layer_w["v_proj"])
+        if spec.qkv_bias:
+            q = q + layer_w["q_bias"]
+            k = k + layer_w["k_bias"]
+            v = v + layer_w["v_bias"]
+        if spec.qkv_clip is not None:
+            q = jnp.clip(q, -spec.qkv_clip, spec.qkv_clip)
+            k = jnp.clip(k, -spec.qkv_clip, spec.qkv_clip)
+            v = jnp.clip(v, -spec.qkv_clip, spec.qkv_clip)
+        q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+        k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+        v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+        if spec.qk_norm:
+            q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+            k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
+        q = apply_rope(q, cos, sin, interleaved=spec.rope_interleaved)
+        k = apply_rope(k, cos, sin, interleaved=spec.rope_interleaved)
 
     if phase == "paged":
         from ..modules import block_kv_cache as bkv
@@ -334,6 +472,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         # and the window/sink must be uniform across layers (static kernel)
         if (spec.flash_prefill and arange_positions and spec.gqa.tp == 1
                 and spec.layer_pattern is None and not spec.attn_sink
+                and spec.mla is None
                 and flash_attention.supports(
                     q.shape[1], spec.head_dim, has_sink=False, chunk=0)):
             attn_out = flash_attention.flash_attention(
@@ -376,8 +515,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
     hidden = hidden + _shard(h, AXIS_DP, None, None)
 
-    h = rms_norm(hidden, layer_w["post_norm"], spec.rms_eps, off)
-    if spec.moe is not None:
+    h = _norm(spec, hidden, layer_w["post_norm"])
+    if mlp_kind == "moe":
         h = moe_block(spec.moe, h, layer_w)
     else:
         act = ACT_FNS[spec.act]
@@ -404,15 +543,33 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
     is_local = jnp.asarray(spec.layer_pattern if spec.layer_pattern is not None
                            else (False,) * spec.num_layers)
 
-    def body(carry, xs):
-        layer_w, kc, vc, loc = xs
-        h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, ai, loc,
-                                seq_ids, positions, phase, identity_seq_ids,
-                                arange_positions, slot_mapping, block_table)
-        return h, (nk, nv)
+    def make_body(mlp_kind):
+        def body(carry, xs):
+            layer_w, kc, vc, loc = xs
+            h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, ai, loc,
+                                    seq_ids, positions, phase,
+                                    identity_seq_ids, arange_positions,
+                                    slot_mapping, block_table, mlp_kind)
+            return h, (nk, nv)
+        return body
+
+    if spec.moe is not None and spec.first_dense > 0:
+        # mixed stacks (deepseek first_k_dense_replace): dense layers then
+        # MoE layers, two scans over one contiguous cache
+        nd = spec.first_dense
+        hidden, (k1, v1) = jax.lax.scan(
+            make_body("dense"), hidden,
+            (params["layers"], cache["k"][:nd], cache["v"][:nd], is_local[:nd]))
+        hidden, (k2, v2) = jax.lax.scan(
+            make_body("moe"), hidden,
+            (params["moe_layers"], cache["k"][nd:], cache["v"][nd:],
+             is_local[nd:]))
+        return hidden, {"k": jnp.concatenate([k1, k2]),
+                        "v": jnp.concatenate([v1, v2])}
 
     hidden, (new_k, new_v) = jax.lax.scan(
-        body, hidden, (params["layers"], cache["k"], cache["v"], is_local))
+        make_body(None), hidden,
+        (params["layers"], cache["k"], cache["v"], is_local))
     return hidden, {"k": new_k, "v": new_v}
 
 
@@ -428,7 +585,7 @@ def _embed(spec: DecoderSpec, params, input_ids):
 
 
 def _lm_head(spec: DecoderSpec, params, hidden):
-    h = rms_norm(hidden, params["final_norm"], spec.rms_eps, spec.norm_offset)
+    h = _norm(spec, hidden, params["final_norm"])
     w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     logits = (h @ w).astype(jnp.float32)
     if spec.logits_soft_cap:

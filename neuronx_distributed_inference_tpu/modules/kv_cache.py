@@ -43,18 +43,27 @@ class KVCacheSpec:
     batch_size: int
     max_seq_len: int
     num_kv_heads: int     # padded/replicated per GQASharding
-    head_dim: int
+    head_dim: int         # K head dim (MLA: qk_nope + qk_rope)
     dtype: jnp.dtype = jnp.bfloat16
     window: int = 0       # >0: rolling sliding-window cache of this length
+    v_head_dim: Optional[int] = None   # MLA: v dim != k dim (deepseek)
 
     @property
     def cache_len(self) -> int:
         return min(self.max_seq_len, self.window) if self.window > 0 else self.max_seq_len
 
     @property
+    def v_dim(self) -> int:
+        return self.v_head_dim if self.v_head_dim is not None else self.head_dim
+
+    @property
     def shape(self) -> Tuple[int, ...]:
         return (self.num_layers, self.batch_size, self.cache_len,
                 self.num_kv_heads, self.head_dim)
+
+    @property
+    def v_shape(self) -> Tuple[int, ...]:
+        return self.shape[:-1] + (self.v_dim,)
 
 
 def cache_pspec() -> P:
@@ -63,13 +72,13 @@ def cache_pspec() -> P:
 
 def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None):
     """Zero-initialized {'k','v'} cache, device-placed with the cache sharding."""
-    if mesh is not None:
-        sharding = NamedSharding(mesh, cache_pspec())
-        zeros = lambda: jax.device_put(
-            jnp.zeros(spec.shape, spec.dtype), sharding)
-    else:
-        zeros = lambda: jnp.zeros(spec.shape, spec.dtype)
-    return {"k": zeros(), "v": zeros()}
+    def zeros(shape):
+        x = jnp.zeros(shape, spec.dtype)
+        if mesh is not None:
+            x = jax.device_put(x, NamedSharding(mesh, cache_pspec()))
+        return x
+
+    return {"k": zeros(spec.shape), "v": zeros(spec.v_shape)}
 
 
 def quantize_kv(x: jnp.ndarray, dtype, scale: Optional[float] = None) -> jnp.ndarray:

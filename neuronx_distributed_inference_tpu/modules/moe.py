@@ -66,6 +66,11 @@ class MoESpec:
     glu_style: str = "gated"
     glu_alpha: float = 1.702
     glu_limit: float = 7.0
+    # group-limited routing (DeepSeek-V3: experts split into n_group groups,
+    # only the topk_group best groups — by sum of their top-2 biased scores —
+    # are eligible for expert selection)
+    n_group: int = 1
+    topk_group: int = 1
     # TOTAL-token-count (B*T) threshold at or below which the dense
     # all-experts path is used; above it the ragged sorted-grouped-matmul
     # path runs. Decode (B*1 tokens) stays dense up to batch 64 by default.
@@ -97,6 +102,21 @@ def route(moe: MoESpec, h: jnp.ndarray, router_w: jnp.ndarray,
     else:
         scores = jax.nn.softmax(logits, axis=-1)
     select = scores + router_bias if router_bias is not None else scores
+    if moe.n_group > 1:
+        # group-limited greedy (DeepSeek-V3 get_topk_indices): rank groups by
+        # the sum of their top-2 biased scores, zero out losing groups
+        b, t, e = select.shape
+        g = moe.n_group
+        grouped = select.reshape(b, t, g, e // g)
+        top2, _ = jax.lax.top_k(grouped, 2)
+        group_scores = top2.sum(axis=-1)                           # (B,T,G)
+        _, group_idx = jax.lax.top_k(group_scores, moe.topk_group)
+        group_mask = jnp.zeros((b, t, g), bool).at[
+            jnp.arange(b)[:, None, None], jnp.arange(t)[None, :, None],
+            group_idx].set(True)
+        mask = jnp.broadcast_to(group_mask[..., None],
+                                grouped.shape).reshape(b, t, e)
+        select = jnp.where(mask, select, 0.0)
     _, top_idx = jax.lax.top_k(select, moe.top_k)                  # (B,T,k)
     top_vals = jnp.take_along_axis(scores, top_idx, axis=-1)
     if moe.pre_softmax_topk and moe.router_act != "sigmoid":

@@ -72,7 +72,8 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         e = jnp.exp(scores - m)
         probs = e / jnp.sum(e, axis=-1, keepdims=True)
     out = jnp.einsum("bhgts,bshd->bthgd", probs, vf)
-    return out.reshape(b, t, hq, d).astype(q.dtype)
+    # v head dim may differ from q/k head dim (MLA, deepseek)
+    return out.reshape(b, t, hq, vf.shape[-1]).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,110 @@
+"""DeepSeek-V3 (MLA + group-limited MoE routing + mixed dense/MoE stacks)
+and DBRX (LayerNorm, clip_qkv, fused experts) golden tests vs HF CPU
+(reference: models/deepseek/, models/dbrx/ — SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.family import get_family
+
+
+def _check_golden(d, hf, model_type, prompt_len=12, atol=5e-3):
+    family = get_family(model_type)
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    icfg = family.config_cls(tcfg, load_config=load_pretrained_config(d))
+    app = CausalLMApplication(d, icfg, family)
+    app.load_weights().init_cache()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 250, size=(2, prompt_len), dtype=np.int64)
+    with torch.no_grad():
+        golden = hf(torch.tensor(ids)).logits.numpy()
+    out = app._run_prefill(ids.astype(np.int32),
+                           np.full((2,), prompt_len, np.int32))
+    np.testing.assert_allclose(np.asarray(out["logits"]), golden,
+                               atol=atol, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_seq = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                             do_sample=False).numpy()
+    app.reset()
+    res = app.generate(ids.astype(np.int32), max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+    return app
+
+
+def test_deepseek_v3_matches_hf(tmp_path):
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+    torch.manual_seed(0)
+    cfg = DeepseekV3Config(
+        hidden_size=64, intermediate_size=128, moe_intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, n_shared_experts=1, num_experts_per_tok=2,
+        first_k_dense_replace=2, n_group=2, topk_group=1,
+        norm_topk_prob=True, routed_scaling_factor=1.5,
+        q_lora_rank=24, kv_lora_rank=16,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        vocab_size=256, rms_norm_eps=1e-5, max_position_embeddings=128,
+        rope_theta=10000.0, rope_scaling=None, tie_word_embeddings=False,
+        attention_bias=False, torch_dtype="float32")
+    hf = DeepseekV3ForCausalLM(cfg)
+    hf.eval()
+    d = tmp_path / "dsv3"
+    hf.save_pretrained(d, safe_serialization=True)
+
+    app = _check_golden(str(d), hf, "deepseek_v3")
+    assert app.spec.mla is not None
+    assert app.spec.first_dense == 2
+    assert app.spec.moe.n_group == 2
+    # MLA cache: K dim = nope+rope, V dim = v_head_dim
+    assert app.cache["k"].shape[-1] == 24
+    assert app.cache["v"].shape[-1] == 16
+
+
+def test_deepseek_v3_no_qlora_yarn(tmp_path):
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+    torch.manual_seed(1)
+    cfg = DeepseekV3Config(
+        hidden_size=64, intermediate_size=128, moe_intermediate_size=32,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=4, n_shared_experts=1, num_experts_per_tok=2,
+        first_k_dense_replace=0, n_group=1, topk_group=1,
+        q_lora_rank=None, kv_lora_rank=16,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        vocab_size=256, rms_norm_eps=1e-5, max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "yarn", "factor": 2.0,
+                      "original_max_position_embeddings": 64,
+                      "beta_fast": 32.0, "beta_slow": 1.0,
+                      "mscale": 1.0, "mscale_all_dim": 1.0},
+        tie_word_embeddings=False, torch_dtype="float32")
+    hf = DeepseekV3ForCausalLM(cfg)
+    hf.eval()
+    d = tmp_path / "dsv3b"
+    hf.save_pretrained(d, safe_serialization=True)
+    _check_golden(str(d), hf, "deepseek_v3")
+
+
+def test_dbrx_matches_hf(tmp_path):
+    from transformers import DbrxConfig, DbrxForCausalLM
+    torch.manual_seed(0)
+    cfg = DbrxConfig(
+        d_model=64, n_heads=4, n_layers=3, max_seq_len=128, vocab_size=256,
+        attn_config={"kv_n_heads": 2, "clip_qkv": 8.0, "rope_theta": 10000.0},
+        ffn_config={"ffn_hidden_size": 48, "moe_num_experts": 4,
+                    "moe_top_k": 2, "moe_normalize_expert_weights": 1},
+        torch_dtype="float32")
+    hf = DbrxForCausalLM(cfg)
+    hf.eval()
+    d = tmp_path / "dbrx"
+    hf.save_pretrained(d, safe_serialization=True)
+
+    app = _check_golden(str(d), hf, "dbrx")
+    assert app.spec.norm_type == "layernorm"
+    assert app.spec.qkv_clip == 8.0
