@@ -212,6 +212,10 @@ class TpuConfig:
     # --- sampling ---
     on_device_sampling_config: Optional[OnDeviceSamplingConfig] = None
     output_logits: bool = False               # return logits (accuracy/debug)
+    # prefill returns the full (B,S,H) hidden states — needed once per
+    # request to prime the EAGLE draft cache (reference: EAGLE CTE,
+    # model_base.py:1931-2092)
+    output_full_hidden: bool = False
 
     # --- speculation ---
     speculation_config: Optional[SpeculationConfig] = None

@@ -139,6 +139,10 @@ class DecoderSpec:
     qkv_clip: Optional[float] = None
     # interleaved (GPT-NeoX pair) rope convention (deepseek rope_interleave)
     rope_interleaved: bool = False
+    # Medusa speculation heads on the target model (reference:
+    # medusa_speculation, model_base.py / models/config.py:243-274):
+    # head j = ResBlock(H->H) + its own lm head, predicting position +j+2
+    medusa_heads: int = 0
     # weight-only quantization (reference: models/config.py:216-241); the
     # param tree then carries {"qweight","scale"} leaf-groups for the
     # converted weights (modules/quantization.py)
@@ -291,6 +295,12 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         out["layers"] = layers
     if not spec.tie_word_embeddings:
         out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_MP), dt)
+    if spec.medusa_heads > 0:
+        M = spec.medusa_heads
+        out["medusa_blocks"] = ParamSpec((M, H, H), P(), dt)
+        out["medusa_bias"] = ParamSpec((M, H), P(), dt, "zeros")
+        out["medusa_lm"] = ParamSpec((M, H, spec.padded_vocab),
+                                     P(None, None, AXIS_MP), dt)
     return out
 
 
@@ -616,10 +626,14 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
     logits = _lm_head(spec, params, last_h)[:, 0, :]
-    out = {"cache": new_cache}
+    # last hidden state feeds EAGLE draft fusion / medusa heads
+    # (reference: EAGLE draft hidden-state fusion, model_base.py:1526-1592)
+    out = {"cache": new_cache, "last_hidden": last_h[:, 0, :]}
     if tpu_cfg.output_logits:
         full_logits = _lm_head(spec, params, hidden)
         out["logits"] = full_logits[..., :spec.vocab_size]
+    if tpu_cfg.output_full_hidden:
+        out["hidden_states"] = hidden
     out["tokens"] = sampling_ops.sample(
         logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
@@ -663,7 +677,8 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
         spec, params, cache, hidden, ai, seq_ids, position_ids,
         "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
-    return {"logits_all": logits[..., :spec.vocab_size], "cache": new_cache}
+    return {"logits_all": logits[..., :spec.vocab_size], "cache": new_cache,
+            "hidden": hidden}
 
 
 def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,

@@ -25,7 +25,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import PartitionSpec as P
+
 from ..config import TpuConfig
+from ..modules.token_tree import TokenTree
+from ..ops import attention as attn_ops
+from ..ops.normalization import rms_norm
+from ..parallel.layers import ParamSpec
 from . import model_base
 from .model_base import DecoderSpec
 
@@ -190,4 +196,526 @@ class SpeculativeDecoder:
             "sequences": np.concatenate([input_ids, gen], axis=1),
             "generated": gen,
             "mean_tokens_per_step": mean_emitted,
+        }
+
+
+# ===========================================================================
+# EAGLE speculation (reference: NeuronFusedSpecModel EAGLE paths,
+# models/model_base.py:1931-2754 + modules/eagle/hidden_state.py)
+# ===========================================================================
+
+def eagle_draft_param_specs(draft_spec: DecoderSpec,
+                            input_norm: bool = False) -> Dict[str, Any]:
+    """Draft param tree = a small decoder + the EAGLE fusion fc mapping
+    concat(embed, prev_hidden) (2H) -> H (reference: EAGLE draft hidden-state
+    fusion, model_base.py:1526-1592)."""
+    specs = model_base.decoder_param_specs(draft_spec)
+    H = draft_spec.hidden_size
+    specs["fc"] = ParamSpec((2 * H, H), P(), draft_spec.dtype)
+    if input_norm:
+        specs["fc_norm"] = ParamSpec((H,), P(), draft_spec.dtype, "ones")
+    return specs
+
+
+def init_eagle_draft_params(draft_spec: DecoderSpec, key, mesh=None,
+                            input_norm: bool = False):
+    import jax
+    from jax.sharding import NamedSharding
+    specs = eagle_draft_param_specs(draft_spec, input_norm)
+    flat, treedef = jax.tree.flatten(
+        specs, is_leaf=lambda x: isinstance(x, ParamSpec))
+    keys = jax.random.split(key, len(flat))
+    leaves = []
+    for k, ps in zip(keys, flat):
+        x = ps.initializer(k)
+        if mesh is not None:
+            x = jax.device_put(x, NamedSharding(mesh, ps.pspec))
+        leaves.append(x)
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def eagle_forward(draft_spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                  tokens, prev_hidden, positions, seq_ids,
+                  input_norm: bool = False):
+    """EAGLE draft forward: token embeddings fused with the previous
+    positions' hidden states through fc, then the draft layer stack.
+
+    tokens (B,T); prev_hidden (B,T,H) = feature of position[t]-1;
+    positions (B,T). The draft writes its KV at ``positions``.
+    """
+    e = model_base._embed(draft_spec, params, tokens)
+    if input_norm:
+        e = rms_norm(e, params["fc_norm"], draft_spec.rms_eps)
+    fused = jnp.concatenate([e, prev_hidden.astype(e.dtype)], axis=-1)
+    h0 = fused @ params["fc"]
+    cache_len = cache["k"].shape[2]
+    ai = model_base.attn_inputs(
+        draft_spec, positions,
+        lambda w: attn_ops.decode_mask(positions, cache_len, window=w))
+    hidden, new_cache = model_base.run_layers(
+        draft_spec, params, cache, h0, ai, seq_ids, positions, "decode",
+        identity_seq_ids=not tpu_cfg.is_continuous_batching)
+    logits = model_base._lm_head(draft_spec, params, hidden)
+    return {"logits": logits[..., :draft_spec.vocab_size], "hidden": hidden,
+            "cache": new_cache}
+
+
+def eagle_speculation_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
+                           tpu_cfg: TpuConfig, draft_params, target_params,
+                           draft_cache, target_cache, last_token, prev_hidden,
+                           positions, seq_ids, input_norm: bool = False):
+    """One fused EAGLE step (reference: _eagle_token_gen_forward
+    :2517-2754): k-step draft scan -> target verify -> cumsum acceptance ->
+    final draft cache-refresh run with the verified target features.
+
+    last_token (B,) at position ``positions``; prev_hidden (B,H) = target
+    feature at positions-1. Returns emitted tokens, per-row count, updated
+    caches, and the next (token, feature) pair.
+    """
+    k = tpu_cfg.speculation_length
+    b = last_token.shape[0]
+
+    def dstep(carry, _):
+        tok, hid, pos, cch = carry
+        out = eagle_forward(draft_spec, tpu_cfg, draft_params, cch,
+                            tok[:, None], hid[:, None, :], pos[:, None],
+                            seq_ids, input_norm)
+        ntok = jnp.argmax(out["logits"][:, -1, :], axis=-1).astype(jnp.int32)
+        nhid = out["hidden"][:, -1, :]
+        return (ntok, nhid, pos + 1, out["cache"]), ntok
+
+    (_, _, _, dcache), dtoks = jax.lax.scan(
+        dstep, (last_token, prev_hidden, positions, draft_cache), None,
+        length=k)
+    draft_tokens = jnp.transpose(dtoks, (1, 0))              # (B, k)
+
+    cand = jnp.concatenate([last_token[:, None], draft_tokens], axis=1)
+    cand_pos = positions[:, None] + jnp.arange(k + 1, dtype=positions.dtype)
+    t_out = model_base.token_generation_multi(
+        target_spec, tpu_cfg, target_params, target_cache, cand, cand_pos,
+        seq_ids)
+    greedy = jnp.argmax(t_out["logits_all"], axis=-1).astype(jnp.int32)
+
+    mismatch = (draft_tokens != greedy[:, :k]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)  # [0, k]
+    idx = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    bonus = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)[:, 0]
+    padded_draft = jnp.concatenate(
+        [draft_tokens, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(idx < n_acc[:, None], padded_draft,
+                       jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
+
+    # next feature: target hidden at position positions + n_acc
+    next_hidden = jnp.take_along_axis(
+        t_out["hidden"], n_acc[:, None, None], axis=1)[:, 0, :]
+
+    # draft cache refresh (reference: final draft cache-update run
+    # :2663-2694): slot p gets the verified pair (token at p, target feature
+    # at p-1); slots beyond the accepted prefix are pushed out of range
+    cache_len = draft_cache["k"].shape[2]
+    hid_seq = jnp.concatenate(
+        [prev_hidden[:, None, :], t_out["hidden"][:, :k, :]], axis=1)
+    refresh_pos = jnp.where(idx <= n_acc[:, None], cand_pos, cache_len)
+    upd = eagle_forward(draft_spec, tpu_cfg, draft_params, dcache, cand,
+                        hid_seq, refresh_pos, seq_ids, input_norm)
+    return {
+        "tokens": tokens,
+        "num_emitted": n_acc + 1,
+        "next_token": bonus,
+        "next_hidden": next_hidden,
+        "draft_cache": upd["cache"],
+        "target_cache": t_out["cache"],
+    }
+
+
+class EagleDecoder:
+    """Host orchestration for fused EAGLE speculation. The per-(seq, position)
+    hidden-state rolling buffer of the reference (modules/eagle/
+    hidden_state.py) collapses to the (next_token, next_hidden) pair threaded
+    between steps — per-seq storage only matters for continuous batching,
+    handled by keying on seq_id here."""
+
+    def __init__(self, target_app, draft_spec: DecoderSpec, draft_params,
+                 draft_cache, input_norm: bool = False):
+        self.target = target_app
+        self.draft_spec = draft_spec
+        self.draft_params = draft_params
+        self.draft_cache = draft_cache
+        self.input_norm = input_norm
+        cfg = target_app.tpu_config
+        if not cfg.speculation_config or cfg.speculation_config.speculation_length < 1:
+            raise ValueError("speculation_config.speculation_length >= 1 required")
+        self.k = cfg.speculation_config.speculation_length
+        self._step = jax.jit(
+            partial(eagle_speculation_step, draft_spec, target_app.spec,
+                    target_app.tpu_config, input_norm=input_norm),
+            donate_argnums=(2, 3))
+        self._prefill = jax.jit(
+            partial(eagle_forward, draft_spec, target_app.tpu_config,
+                    input_norm=input_norm),
+            donate_argnums=(1,))
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids).astype(np.int32)
+        b, s = input_ids.shape
+        cfg = self.target.tpu_config
+        if not cfg.output_full_hidden:
+            raise ValueError("target app needs output_full_hidden=True "
+                             "(EAGLE primes the draft from prefill hiddens)")
+        seq_lens = np.full((b,), s, np.int32)
+        seq_ids = np.arange(b, dtype=np.int32)
+        t_out = self.target._run_prefill(input_ids, seq_lens)
+        hs = np.asarray(t_out["hidden_states"])[:, :s]       # (B,S,H)
+        first = np.asarray(t_out["tokens"]).astype(np.int32)
+
+        # prime the draft cache over the prompt: slot p <- (token p, feat p-1)
+        if s > 1:
+            d_out = self._prefill(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(input_ids[:, 1:]), jnp.asarray(hs[:, :-1]),
+                jnp.broadcast_to(jnp.arange(1, s, dtype=jnp.int32), (b, s - 1)),
+                jnp.asarray(seq_ids))
+            self.draft_cache = d_out["cache"]
+
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        out_rows = [[int(first[i])] for i in range(b)]
+        last = first
+        prev_hidden = jnp.asarray(hs[:, -1])
+        positions = seq_lens.copy()
+        done = np.zeros((b,), bool)
+        emitted_counts = []
+        max_total = cfg.seq_len
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + self.k + 1 < max_total
+               and not done.all()):
+            res = self._step(self.draft_params, self.target.params,
+                             self.draft_cache, self.target.cache,
+                             jnp.asarray(last), prev_hidden,
+                             jnp.asarray(positions), jnp.asarray(seq_ids))
+            self.draft_cache = res["draft_cache"]
+            self.target.cache = res["target_cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_emitted"])
+            emitted_counts.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                for t in toks[i, :n_emit[i]].tolist():
+                    out_rows[i].append(int(t))
+                    if eos_set is not None and int(t) in eos_set:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            last = np.asarray(res["next_token"]).astype(np.int32)
+            prev_hidden = res["next_hidden"]
+
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+            if len(row) < max_new_tokens:
+                gen[i, len(row):] = row[-1]
+        return {
+            "sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen,
+            "mean_tokens_per_step": (float(np.mean(np.concatenate(
+                emitted_counts))) if emitted_counts else 0.0),
+        }
+
+
+# ===========================================================================
+# Medusa speculation (reference: medusa_speculation_model submodel +
+# hf_adapter medusa decode loop :799-890)
+# ===========================================================================
+
+def medusa_propose(spec: DecoderSpec, params, hidden, top_k: int = 1):
+    """Run the medusa heads on (B,H) features: head j = ResBlock + lm head
+    predicting position +j+2. Returns (B, M, top_k) token ids."""
+    h = hidden[:, None, :]                                   # (B,1,H)
+    r = h + jax.nn.silu(
+        jnp.einsum("bmh,mhk->bmk", jnp.broadcast_to(
+            h, (h.shape[0], params["medusa_blocks"].shape[0], h.shape[-1])),
+            params["medusa_blocks"]) + params["medusa_bias"])
+    logits = jnp.einsum("bmh,mhv->bmv", r, params["medusa_lm"])
+    logits = logits[..., :spec.vocab_size].astype(jnp.float32)
+    _, idx = jax.lax.top_k(logits, top_k)
+    return idx.astype(jnp.int32)                             # (B,M,top_k)
+
+
+def medusa_speculation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
+                            cache, cand, positions, seq_ids):
+    """One fused medusa step: verify the candidate chain
+    [last_emitted, p1..p_{k-1}] in one forward, accept the matching prefix,
+    emit the bonus, and propose the next chain from the accepted feature
+    (reference: medusa speculation graph + postprocessor)."""
+    b, k = cand.shape
+    cand_pos = positions[:, None] + jnp.arange(k, dtype=positions.dtype)
+    out = model_base.token_generation_multi(
+        spec, tpu_cfg, params, cache, cand, cand_pos, seq_ids)
+    greedy = jnp.argmax(out["logits_all"], axis=-1).astype(jnp.int32)  # (B,k)
+    mismatch = (cand[:, 1:] != greedy[:, :k - 1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)  # [0, k-1]
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    bonus = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)[:, 0]
+    shifted = jnp.concatenate([cand[:, 1:], jnp.zeros((b, 1), jnp.int32)], 1)
+    tokens = jnp.where(idx < n_acc[:, None], shifted,
+                       jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
+    feat = jnp.take_along_axis(out["hidden"], n_acc[:, None, None], axis=1)[:, 0]
+    props = medusa_propose(spec, params, feat)[:, :k - 1, 0]   # (B,k-1)
+    next_cand = jnp.concatenate([bonus[:, None], props], axis=1)
+    return {"tokens": tokens, "num_emitted": n_acc + 1,
+            "next_cand": next_cand, "cache": out["cache"]}
+
+
+class MedusaDecoder:
+    """Host loop for medusa speculation (chain mode). The target app's spec
+    must carry medusa_heads > 0 (params include the heads)."""
+
+    def __init__(self, target_app):
+        self.target = target_app
+        cfg = target_app.tpu_config
+        sc = cfg.speculation_config
+        if not sc or sc.medusa_speculation_length < 1:
+            raise ValueError("speculation_config.medusa_speculation_length "
+                             ">= 1 required")
+        self.k = min(sc.medusa_speculation_length,
+                     target_app.spec.medusa_heads + 1)
+        self._step = jax.jit(
+            partial(medusa_speculation_step, target_app.spec, cfg),
+            donate_argnums=(1,))
+        self._propose = jax.jit(partial(medusa_propose, target_app.spec),
+                                static_argnames=("top_k",))
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids).astype(np.int32)
+        b, s = input_ids.shape
+        cfg = self.target.tpu_config
+        seq_lens = np.full((b,), s, np.int32)
+        seq_ids = np.arange(b, dtype=np.int32)
+        t_out = self.target._run_prefill(input_ids, seq_lens)
+        first = np.asarray(t_out["tokens"]).astype(np.int32)
+        feat = t_out["last_hidden"]
+        props = np.asarray(self._propose(self.target.params, feat))[:, :self.k - 1, 0]
+        cand = np.concatenate([first[:, None], props], axis=1)
+
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        out_rows = [[int(first[i])] for i in range(b)]
+        positions = seq_lens.copy()
+        done = np.zeros((b,), bool)
+        emitted_counts = []
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + self.k < cfg.seq_len
+               and not done.all()):
+            res = self._step(self.target.params, self.target.cache,
+                             jnp.asarray(cand), jnp.asarray(positions),
+                             jnp.asarray(seq_ids))
+            self.target.cache = res["cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_emitted"])
+            emitted_counts.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                for t in toks[i, :n_emit[i]].tolist():
+                    out_rows[i].append(int(t))
+                    if eos_set is not None and int(t) in eos_set:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            cand = np.asarray(res["next_cand"])
+
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+            if len(row) < max_new_tokens:
+                gen[i, len(row):] = row[-1]
+        return {
+            "sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen,
+            "mean_tokens_per_step": (float(np.mean(np.concatenate(
+                emitted_counts))) if emitted_counts else 0.0),
+        }
+
+
+# ===========================================================================
+# Token-tree verification (reference: modules/eagle/token_tree.py per-level
+# masks + tree-attention verify; used here in medusa tree mode)
+# ===========================================================================
+
+def tree_forward(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                 node_tokens, rope_positions, write_positions, seq_ids, mask):
+    """Forward over tree nodes with an explicit attention mask: node i writes
+    cache slot ``write_positions[:, i]`` and attends per ``mask`` (committed
+    prefix + ancestors). rope uses the node's logical position (base+depth)."""
+    assert spec.layer_pattern is None, "tree verify + layer patterns TBD"
+    ai = {"mask": mask.astype(bool)}
+    from ..ops.rope import rope_cos_sin
+    ai["cos"], ai["sin"] = rope_cos_sin(rope_positions, spec.rope)
+    hidden = model_base._embed(spec, params, node_tokens)
+    hidden, new_cache = model_base.run_layers(
+        spec, params, cache, hidden, ai, seq_ids, write_positions, "decode",
+        identity_seq_ids=not tpu_cfg.is_continuous_batching)
+    logits = model_base._lm_head(spec, params, hidden)
+    return {"logits_all": logits[..., :spec.vocab_size], "hidden": hidden,
+            "cache": new_cache}
+
+
+def medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                     node_tokens, base_pos, seq_ids, tree_mask,
+                     paths, path_lens, depth):
+    """One medusa tree-verify step. node_tokens (B,N) — node 0 is the last
+    emitted token; tree_mask (B,N,S) from TokenTree.attention_mask; paths
+    (P,D+1)/path_lens (P,) from leaf_path_matrix; depth (N,).
+
+    Accept the path with the most leading greedy matches; emit its tokens +
+    the bonus; return the accepted feature for the next proposals."""
+    b, n = node_tokens.shape
+    rope_pos = base_pos[:, None] + depth[None, :]
+    write_pos = base_pos[:, None] + jnp.arange(n, dtype=base_pos.dtype)
+    out = tree_forward(spec, tpu_cfg, params, cache, node_tokens, rope_pos,
+                       write_pos, seq_ids, tree_mask)
+    greedy = jnp.argmax(out["logits_all"], axis=-1).astype(jnp.int32)  # (B,N)
+
+    safe_paths = jnp.maximum(paths, 0)                       # (P,D+1)
+    tok_at = node_tokens[:, safe_paths]                      # (B,P,D+1)
+    pred_at = greedy[:, safe_paths]
+    edge_valid = (paths[None, :, 1:] >= 0)
+    match = (tok_at[:, :, 1:] == pred_at[:, :, :-1]) & edge_valid
+    acc_len = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=-1), axis=-1)
+    best = jnp.argmax(acc_len, axis=-1).astype(jnp.int32)    # (B,)
+    n_acc = jnp.take_along_axis(acc_len, best[:, None], 1)[:, 0]
+
+    best_path = safe_paths[best]                             # (B,D+1)
+    d1 = paths.shape[1]
+    idx = jnp.arange(d1, dtype=jnp.int32)[None, :]
+    path_toks = jnp.take_along_axis(node_tokens, best_path, axis=1)
+    path_pred = jnp.take_along_axis(greedy, best_path, axis=1)
+    bonus = jnp.take_along_axis(path_pred, n_acc[:, None], 1)[:, 0]
+    # emitted: path tokens 1..n_acc then the bonus
+    shifted = jnp.concatenate(
+        [path_toks[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(idx < n_acc[:, None], shifted,
+                       jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
+    feat_node = jnp.take_along_axis(best_path, n_acc[:, None], 1)[:, 0]
+    feat = jnp.take_along_axis(out["hidden"], feat_node[:, None, None],
+                               axis=1)[:, 0]
+
+    # cache refresh: rewrite slots base..base+n_acc+1 with the linear
+    # accepted sequence [root, accepted..., bonus]; stale tree slots beyond
+    # are overwritten by the next step's writes
+    refresh_toks = jnp.concatenate([node_tokens[:, :1], tokens], axis=1)
+    r_w = refresh_toks.shape[1]
+    ridx = jnp.arange(r_w, dtype=jnp.int32)[None, :]
+    rpos = base_pos[:, None] + ridx
+    # invalid tail slots: push writes out of range (dropped)
+    rpos = jnp.where(ridx <= (n_acc + 1)[:, None], rpos,
+                     out["cache"]["k"].shape[2])
+    upd = model_base.token_generation_multi(
+        spec, tpu_cfg, params, out["cache"], refresh_toks, rpos, seq_ids)
+    return {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,
+            "feature": feat, "cache": upd["cache"]}
+
+
+class MedusaTreeDecoder:
+    """Host loop for medusa TREE speculation: heads propose top-w candidates
+    per level, the tree is verified in one forward, the best path wins."""
+
+    def __init__(self, target_app, tree: Optional[TokenTree] = None):
+        from ..modules.token_tree import DEFAULT_TREE
+        self.target = target_app
+        cfg = target_app.tpu_config
+        sc = cfg.speculation_config
+        if not sc or target_app.spec.medusa_heads < 1:
+            raise ValueError("medusa heads required")
+        if tree is None:
+            tree = TokenTree.from_config(sc.token_tree_config or DEFAULT_TREE)
+        if tree.max_depth > target_app.spec.medusa_heads:
+            raise ValueError("tree deeper than medusa head count")
+        self.tree = tree
+        self.paths, self.path_lens = tree.leaf_path_matrix()
+        self.max_width = int(tree.level_widths.max())
+        self._step = jax.jit(
+            partial(medusa_tree_step, target_app.spec, cfg),
+            donate_argnums=(1,))
+        self._propose = jax.jit(partial(medusa_propose, target_app.spec),
+                                static_argnames=("top_k",))
+
+    def _node_tokens(self, root, props):
+        """Assemble (B,N) node tokens: node at depth d, branch b takes
+        props[:, d-1, b]; node 0 = root."""
+        t = self.tree
+        b = root.shape[0]
+        out = np.zeros((b, t.num_nodes), np.int32)
+        out[:, 0] = root
+        for i in range(1, t.num_nodes):
+            out[:, i] = props[:, t.depth[i] - 1, t.branch[i]]
+        return out
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids).astype(np.int32)
+        b, s = input_ids.shape
+        cfg = self.target.tpu_config
+        t = self.tree
+        seq_lens = np.full((b,), s, np.int32)
+        seq_ids = np.arange(b, dtype=np.int32)
+        t_out = self.target._run_prefill(input_ids, seq_lens)
+        root = np.asarray(t_out["tokens"]).astype(np.int32)
+        props = np.asarray(self._propose(self.target.params,
+                                         t_out["last_hidden"],
+                                         top_k=self.max_width))
+
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        out_rows = [[int(root[i])] for i in range(b)]
+        positions = seq_lens.copy()
+        done = np.zeros((b,), bool)
+        emitted_counts = []
+        cache_len = cfg.seq_len
+        depth = jnp.asarray(t.depth)
+        paths = jnp.asarray(self.paths)
+        plens = jnp.asarray(self.path_lens)
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + t.num_nodes + 1 < cache_len
+               and not done.all()):
+            node_toks = self._node_tokens(root, props)
+            mask = t.attention_mask(positions, cache_len)
+            res = self._step(self.target.params, self.target.cache,
+                             jnp.asarray(node_toks), jnp.asarray(positions),
+                             jnp.asarray(seq_ids), jnp.asarray(mask),
+                             paths, plens, depth)
+            self.target.cache = res["cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_emitted"])
+            emitted_counts.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                for tk in toks[i, :n_emit[i]].tolist():
+                    out_rows[i].append(int(tk))
+                    if eos_set is not None and int(tk) in eos_set:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            root = np.asarray(res["bonus"]).astype(np.int32)
+            props = np.asarray(self._propose(self.target.params,
+                                             res["feature"],
+                                             top_k=self.max_width))
+
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+            if len(row) < max_new_tokens:
+                gen[i, len(row):] = row[-1]
+        return {
+            "sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen,
+            "mean_tokens_per_step": (float(np.mean(np.concatenate(
+                emitted_counts))) if emitted_counts else 0.0),
         }

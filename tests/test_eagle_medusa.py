@@ -1,0 +1,157 @@
+"""EAGLE + Medusa speculation tests (reference: NeuronFusedSpecModel EAGLE
+paths model_base.py:1931-2754, medusa submodel, modules/eagle/token_tree.py).
+
+The gold property: greedy speculation is LOSSLESS — emitted tokens must be
+identical to plain greedy decoding of the target, regardless of draft/head
+quality (random weights here)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (SpeculationConfig,
+                                                      TpuConfig)
+from neuronx_distributed_inference_tpu.models import model_base, speculation
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules.kv_cache import (KVCacheSpec,
+                                                                init_cache)
+from neuronx_distributed_inference_tpu.modules.token_tree import (DEFAULT_TREE,
+                                                                  TokenTree)
+from neuronx_distributed_inference_tpu.parallel.mesh import (MeshConfig,
+                                                             build_mesh)
+
+from conftest import tiny_llama_hf_config
+
+
+def _target_app(seq_len=96, spec_cfg=None, medusa_heads=0, **tcfg_over):
+    tcfg = TpuConfig(batch_size=2, seq_len=seq_len, dtype="float32",
+                     enable_bucketing=False, speculation_config=spec_cfg,
+                     **tcfg_over)
+    icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+    mesh = build_mesh(MeshConfig(tp=1))
+    app = CausalLMApplication(None, icfg, LlamaFamily, mesh=mesh)
+    if medusa_heads:
+        import dataclasses
+        app.spec = dataclasses.replace(app.spec, medusa_heads=medusa_heads)
+    app.init_random_weights(seed=0)
+    app.init_cache()
+    return app
+
+
+def _plain_greedy(prompts, n, seq_len=96):
+    app = _target_app(seq_len=seq_len)
+    out = app.generate(prompts, max_new_tokens=n)
+    return out["generated"]
+
+
+def test_eagle_matches_plain_greedy(rng):
+    prompts = rng.integers(1, 500, size=(2, 10)).astype(np.int32)
+    golden = _plain_greedy(prompts, 16)
+
+    spec_cfg = SpeculationConfig(speculation_length=3,
+                                 enable_fused_speculation=True,
+                                 enable_eagle_speculation=True)
+    target = _target_app(spec_cfg=spec_cfg, output_full_hidden=True)
+    # tiny 2-layer EAGLE draft sharing the target's architecture family
+    draft_spec = model_base.spec_from_config(
+        target.config, tp_degree=1, num_layers=2)
+    draft_params = speculation.init_eagle_draft_params(
+        draft_spec, jax.random.PRNGKey(7), target.mesh)
+    draft_cache = init_cache(KVCacheSpec(
+        num_layers=2, batch_size=2, max_seq_len=96,
+        num_kv_heads=draft_spec.gqa.num_kv_heads,
+        head_dim=draft_spec.head_dim, dtype=draft_spec.kv_dtype), target.mesh)
+    dec = speculation.EagleDecoder(target, draft_spec, draft_params,
+                                   draft_cache)
+    out = dec.generate(prompts, max_new_tokens=16)
+    np.testing.assert_array_equal(out["generated"], golden)
+    assert out["mean_tokens_per_step"] >= 1.0
+
+
+def test_eagle_draft_input_norm_variant(rng):
+    prompts = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    golden = _plain_greedy(prompts, 8)
+    spec_cfg = SpeculationConfig(speculation_length=2,
+                                 enable_fused_speculation=True,
+                                 enable_eagle_speculation=True,
+                                 enable_eagle_draft_input_norm=True)
+    target = _target_app(spec_cfg=spec_cfg, output_full_hidden=True)
+    draft_spec = model_base.spec_from_config(target.config, tp_degree=1,
+                                             num_layers=1)
+    draft_params = speculation.init_eagle_draft_params(
+        draft_spec, jax.random.PRNGKey(3), target.mesh, input_norm=True)
+    draft_cache = init_cache(KVCacheSpec(
+        num_layers=1, batch_size=2, max_seq_len=96,
+        num_kv_heads=draft_spec.gqa.num_kv_heads,
+        head_dim=draft_spec.head_dim, dtype=draft_spec.kv_dtype), target.mesh)
+    dec = speculation.EagleDecoder(target, draft_spec, draft_params,
+                                   draft_cache, input_norm=True)
+    out = dec.generate(prompts, max_new_tokens=8)
+    np.testing.assert_array_equal(out["generated"], golden)
+
+
+def test_medusa_matches_plain_greedy(rng):
+    prompts = rng.integers(1, 500, size=(2, 10)).astype(np.int32)
+    golden = _plain_greedy(prompts, 16)
+    spec_cfg = SpeculationConfig(medusa_speculation_length=4,
+                                 num_medusa_heads=3)
+    target = _target_app(spec_cfg=spec_cfg, medusa_heads=3)
+    dec = speculation.MedusaDecoder(target)
+    out = dec.generate(prompts, max_new_tokens=16)
+    np.testing.assert_array_equal(out["generated"], golden)
+    assert out["mean_tokens_per_step"] >= 1.0
+
+
+def test_token_tree_structure():
+    tree = TokenTree(DEFAULT_TREE)
+    # root + 7 config nodes
+    assert tree.num_nodes == 8
+    assert tree.max_depth == 3
+    assert tree.depth.tolist() == [0, 1, 1, 1, 2, 2, 2, 3]
+    # node ordering: (), (0), (1), (2), (0,0), (0,1), (1,0), (0,0,0)
+    assert tree.parent.tolist() == [-1, 0, 0, 0, 1, 1, 2, 4]
+    # every node attends itself and its ancestors only
+    anc = tree.ancestor_mask
+    assert anc[7].tolist() == [True, True, False, False, True, False, False,
+                               True]
+    assert tree.level_widths.tolist() == [3, 2, 1]
+    paths, lens = tree.leaf_path_matrix()
+    assert paths.shape == (8, 4)
+    assert lens.max() == 4
+
+
+def test_token_tree_attention_mask():
+    tree = TokenTree([[0], [1], [0, 0]])
+    base = np.array([4, 2])
+    mask = tree.attention_mask(base, cache_len=12)
+    assert mask.shape == (2, 4, 12)
+    # every node sees the committed prefix
+    assert mask[0, :, :4].all() and mask[1, :, :2].all()
+    # node 3 = (0,0): slot base+3 sees root slot (base), node1 slot (base+1),
+    # itself (base+3), not node2 (base+2)
+    assert mask[0, 3, 4] and mask[0, 3, 5] and mask[0, 3, 7]
+    assert not mask[0, 3, 6]
+    # nothing beyond the tree slots
+    assert not mask[0, :, 8:].any()
+
+
+def test_token_tree_requires_parents():
+    with pytest.raises(ValueError):
+        TokenTree([[0, 0]])  # parent [0] missing
+
+
+def test_medusa_tree_matches_plain_greedy(rng):
+    prompts = rng.integers(1, 500, size=(2, 10)).astype(np.int32)
+    golden = _plain_greedy(prompts, 16)
+    spec_cfg = SpeculationConfig(medusa_speculation_length=4,
+                                 num_medusa_heads=3,
+                                 token_tree_config={"paths": DEFAULT_TREE})
+    target = _target_app(spec_cfg=spec_cfg, medusa_heads=3)
+    dec = speculation.MedusaTreeDecoder(target)
+    out = dec.generate(prompts, max_new_tokens=16)
+    np.testing.assert_array_equal(out["generated"], golden)
+    assert out["mean_tokens_per_step"] >= 1.0
