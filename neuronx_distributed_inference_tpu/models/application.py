@@ -236,7 +236,7 @@ class CausalLMApplication:
 
     def _run_prefill(self, input_ids: np.ndarray, seq_lens: np.ndarray,
                      seq_ids: Optional[np.ndarray] = None,
-                     sampling_params=None):
+                     sampling_params=None, adapter_ids=None):
         b, s = input_ids.shape
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -246,12 +246,14 @@ class CausalLMApplication:
             sampling_params = self._default_sampling_params(b)
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
-                 jnp.asarray(seq_lens), sampling_params, self._next_rng())
+                 jnp.asarray(seq_lens), sampling_params, self._next_rng(),
+                 adapter_ids)
         self.cache = out["cache"]
         return out
 
     def _run_decode(self, input_ids: np.ndarray, position_ids: np.ndarray,
-                    seq_ids: Optional[np.ndarray] = None, sampling_params=None):
+                    seq_ids: Optional[np.ndarray] = None, sampling_params=None,
+                    adapter_ids=None):
         b = input_ids.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -267,13 +269,13 @@ class CausalLMApplication:
             sampling_params = self._default_sampling_params(b)
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
-                 sampling_params, self._next_rng())
+                 sampling_params, self._next_rng(), adapter_ids)
         self.cache = out["cache"]
         return out
 
     def _run_decode_loop(self, first_tokens: np.ndarray, positions: np.ndarray,
                          num_steps: int, seq_ids: Optional[np.ndarray] = None,
-                         sampling_params=None):
+                         sampling_params=None, adapter_ids=None):
         b = first_tokens.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -282,7 +284,8 @@ class CausalLMApplication:
             sampling_params = self._default_sampling_params(b)
         out = fn(self.params, self.cache, jnp.asarray(first_tokens),
                  jnp.asarray(positions), jnp.asarray(seq_ids), sampling_params,
-                 self._next_rng(), num_steps=num_steps)
+                 self._next_rng(), num_steps=num_steps,
+                 adapter_ids=adapter_ids)
         self.cache = out["cache"]
         return out
 
@@ -296,16 +299,21 @@ class CausalLMApplication:
                  eos_token_id: Optional[int] = None,
                  sampling_params: Optional[np.ndarray] = None,
                  return_logits: bool = False,
-                 teacher_tokens: Optional[np.ndarray] = None) -> Dict[str, Any]:
+                 teacher_tokens: Optional[np.ndarray] = None,
+                 adapter_ids: Optional[np.ndarray] = None) -> Dict[str, Any]:
         """Greedy/sampled generation. input_ids (B, S) right-padded;
         attention_mask (B, S) marks real tokens. Returns sequences including
         the prompt (HF convention).
 
         teacher_tokens (B, T): teacher-forcing for logit-matching accuracy —
         feed these instead of the sampled tokens (reference:
-        utils/accuracy.py logit flow re-feeds golden tokens)."""
+        utils/accuracy.py logit flow re-feeds golden tokens).
+        adapter_ids (B,): per-request LoRA adapter slot (multi-LoRA serving,
+        reference: modules/lora_serving/)."""
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
+        if adapter_ids is not None:
+            adapter_ids = jnp.asarray(np.asarray(adapter_ids, np.int32))
         if attention_mask is None:
             attention_mask = np.ones_like(input_ids)
         seq_lens = attention_mask.astype(np.int32).sum(axis=1)
@@ -330,7 +338,8 @@ class CausalLMApplication:
                 raise ValueError("prompt exceeds seq_len")
 
         t0 = time.perf_counter()
-        out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params)
+        out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params,
+                                adapter_ids=adapter_ids)
         tokens = np.asarray(out["tokens"]).reshape(b, 1)
         logits_trace = [np.asarray(out["logits"])] if return_logits and "logits" in out else []
         ttft = time.perf_counter() - t0
@@ -358,7 +367,8 @@ class CausalLMApplication:
                 n = 1
             if n == 1 or return_logits:
                 o = self._run_decode(cur[:, None], positions[:, None],
-                                     sampling_params=sampling_params)
+                                     sampling_params=sampling_params,
+                                     adapter_ids=adapter_ids)
                 new = np.asarray(o["tokens"]).reshape(b, 1)
                 if return_logits and "logits" in o:
                     logits_trace.append(np.asarray(o["logits"]))
@@ -366,7 +376,8 @@ class CausalLMApplication:
                 n_generated += 1
             else:
                 o = self._run_decode_loop(cur, positions, n,
-                                          sampling_params=sampling_params)
+                                          sampling_params=sampling_params,
+                                          adapter_ids=adapter_ids)
                 new = np.asarray(o["tokens"])
                 positions = positions + n
                 n_generated += n
@@ -385,6 +396,64 @@ class CausalLMApplication:
     def reset(self):
         """Clear KV cache between requests."""
         self.init_cache()
+        return self
+
+    # ------------------------------------------------------------------
+    # multi-LoRA serving (reference: modules/lora_serving/)
+    # ------------------------------------------------------------------
+    def load_lora_adapters(self, ckpt_paths: Optional[Dict[str, str]] = None):
+        """Load PEFT adapter checkpoints into slots 1..N (slot 0 stays the
+        zero adapter = base model). ckpt_paths {name: dir}; defaults to
+        tpu_config.lora_config.lora_ckpt_paths. Returns {name: slot}."""
+        from ..modules import lora as lora_mod
+        lc = self.tpu_config.lora_config
+        if self.spec.lora is None or lc is None:
+            raise ValueError("lora_config must be set on the TpuConfig")
+        ckpt_paths = ckpt_paths or lc.lora_ckpt_paths or {}
+        if self.params is None:
+            raise RuntimeError("load_weights() first")
+        slots: Dict[str, int] = {}
+        for slot, (name, path) in enumerate(ckpt_paths.items(), start=1):
+            if slot >= self.spec.lora.max_loras:
+                raise ValueError(f"adapter {name!r}: slot {slot} exceeds "
+                                 f"max_loras {self.spec.lora.max_loras}")
+            self.set_lora_adapter(slot, path)
+            slots[name] = slot
+        self.lora_slots = slots
+        return slots
+
+    def set_lora_adapter(self, slot: int, path: str):
+        """Dynamic multi-LoRA: (re)load one adapter dir into ``slot``
+        (reference: host-side adapter swap, models/model_base.py:3349-3356)."""
+        from ..modules import lora as lora_mod
+        from ..parallel.layers import place_q_weight, replicate_kv_weight
+        sd, acfg = lora_mod.load_peft_adapter(path)
+        lo = self.spec.lora
+        g = self.spec.gqa
+        D = self.spec.head_dim
+        dims = {
+            "q_proj": (self.spec.hidden_size, self.spec.q_size),
+            "k_proj": (self.spec.hidden_size, self.spec.kv_size),
+            "v_proj": (self.spec.hidden_size, self.spec.kv_size),
+            "o_proj": (self.spec.q_size, self.spec.hidden_size),
+            "gate_proj": (self.spec.hidden_size, self.spec.intermediate_size),
+            "up_proj": (self.spec.hidden_size, self.spec.intermediate_size),
+            "down_proj": (self.spec.intermediate_size, self.spec.hidden_size),
+        }
+        transforms = {
+            "q_proj": lambda b: place_q_weight(b, g, D, -1),
+            "k_proj": lambda b: replicate_kv_weight(b, g, D, -1),
+            "v_proj": lambda b: replicate_kv_weight(b, g, D, -1),
+        }
+        for mod in lo.target_modules:
+            d_in, d_out = dims[mod]
+            # o_proj's A consumes the padded head layout on its input side
+            in_transform = (lambda a: place_q_weight(a, g, D, 0)) \
+                if mod == "o_proj" else None
+            a, b = lora_mod.adapter_layer_arrays(
+                sd, acfg, self.spec.num_layers, mod, d_in, d_out, lo.rank,
+                out_transform=transforms.get(mod), in_transform=in_transform)
+            lora_mod.set_adapter_slot(self.params, "layers", slot, mod, a, b)
         return self
 
 

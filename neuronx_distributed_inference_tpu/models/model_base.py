@@ -43,6 +43,7 @@ from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
 from ..modules.moe import MoESpec, moe_block
+from ..modules.lora import (LoraSpec, apply_lora, lora_spec_from_config)
 from ..modules.quantization import (QuantSpec, qlinear,
                                     quant_spec_from_config)
 
@@ -143,6 +144,9 @@ class DecoderSpec:
     # medusa_speculation, model_base.py / models/config.py:243-274):
     # head j = ResBlock(H->H) + its own lm head, predicting position +j+2
     medusa_heads: int = 0
+    # multi-LoRA serving (reference: modules/lora_serving/): stacked
+    # per-adapter A/B weights selected by per-request adapter_ids
+    lora: Optional[LoraSpec] = None
     # weight-only quantization (reference: models/config.py:216-241); the
     # param tree then carries {"qweight","scale"} leaf-groups for the
     # converted weights (modules/quantization.py)
@@ -225,17 +229,46 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
     if spec.attn_sink:
         layers["sink"] = ParamSpec((L, spec.gqa.num_q_heads),
                                    P(None, AXIS_MP), jnp.float32, "zeros")
+    if spec.lora is not None and spec.mla is None:
+        _add_lora_specs(spec, layers, L, {
+            "q_proj": (H, spec.q_size), "k_proj": (H, spec.kv_size),
+            "v_proj": (H, spec.kv_size), "o_proj": (spec.q_size, H)})
     return layers
+
+
+def _add_lora_specs(spec: DecoderSpec, layers: Dict[str, ParamSpec], L: int,
+                    dims: Dict[str, Tuple[int, int]]) -> None:
+    """Stacked adapter weights for each targeted module
+    (reference: modules/lora_serving/lora_layer.py parallel LoRA linears).
+    A (L, max_loras, in, r) replicated; B (L, max_loras, r, out) sharded
+    like the base weight's out dim when it is model-parallel."""
+    lo = spec.lora
+    dt = spec.dtype
+    col_sharded = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+    for mod, (d_in, d_out) in dims.items():
+        if not lo.targets(mod):
+            continue
+        a_spec = P(None, None, AXIS_MP, None) if mod in ("o_proj", "down_proj") \
+            else P()
+        b_spec = P(None, None, None, AXIS_MP) if mod in col_sharded else P()
+        layers[f"lora_A_{mod}"] = ParamSpec(
+            (L, lo.max_loras, d_in, lo.rank), a_spec, dt, "zeros")
+        layers[f"lora_B_{mod}"] = ParamSpec(
+            (L, lo.max_loras, lo.rank, d_out), b_spec, dt, "zeros")
 
 
 def _dense_mlp_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
     H, I = spec.hidden_size, spec.intermediate_size
     dt = spec.dtype
-    return {
+    layers = {
         "gate_proj": column_parallel(H, I, dt, True, L),
         "up_proj": column_parallel(H, I, dt, True, L),
         "down_proj": row_parallel(I, H, dt, True, L),
     }
+    if spec.lora is not None:
+        _add_lora_specs(spec, layers, L, {
+            "gate_proj": (H, I), "up_proj": (H, I), "down_proj": (I, H)})
+    return layers
 
 
 def _moe_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
@@ -304,21 +337,31 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
     return out
 
 
-def init_params(spec: DecoderSpec, key: jax.Array,
-                mesh: Optional[Mesh] = None) -> Dict[str, Any]:
-    """Random-init a sharded param tree (tiny-model tests / benchmarks with
-    synthetic weights — reference: modules/checkpoint.py:202-287 random
-    N-layer checkpoint creation)."""
-    specs = decoder_param_specs(spec)
-    flat, treedef = jax.tree.flatten(specs, is_leaf=lambda x: isinstance(x, ParamSpec))
-    keys = jax.random.split(key, len(flat))
+def init_param_tree(specs: Dict[str, Any], key: jax.Array,
+                    mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    """Random-init a ParamSpec tree. Per-leaf keys are derived from the leaf
+    PATH (fold_in of a stable hash), so adding optional params (lora, medusa)
+    never reshuffles the other weights for a given seed."""
+    import zlib
+    flat, treedef = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, ParamSpec))
     leaves = []
-    for k, ps in zip(keys, flat):
+    for path, ps in flat:
+        pstr = "/".join(str(p) for p in path)
+        k = jax.random.fold_in(key, zlib.crc32(pstr.encode()) & 0x7FFFFFFF)
         x = ps.initializer(k)
         if mesh is not None:
             x = jax.device_put(x, NamedSharding(mesh, ps.pspec))
         leaves.append(x)
     return jax.tree.unflatten(treedef, leaves)
+
+
+def init_params(spec: DecoderSpec, key: jax.Array,
+                mesh: Optional[Mesh] = None) -> Dict[str, Any]:
+    """Random-init a sharded param tree (tiny-model tests / benchmarks with
+    synthetic weights — reference: modules/checkpoint.py:202-287 random
+    N-layer checkpoint creation)."""
+    return init_param_tree(decoder_param_specs(spec), key, mesh)
 
 
 def param_shardings(spec: DecoderSpec, mesh: Mesh):
@@ -406,7 +449,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                 identity_seq_ids: bool = False,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None,
-                mlp_kind: Optional[str] = None):
+                mlp_kind: Optional[str] = None,
+                adapter_ids=None):
     """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D) — or, in
     the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
     ``block_table`` set (phase "paged", reference:
@@ -441,9 +485,12 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     if spec.mla is not None:
         q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
     else:
-        q = qlinear(h, layer_w["q_proj"])
-        k = qlinear(h, layer_w["k_proj"])
-        v = qlinear(h, layer_w["v_proj"])
+        q = apply_lora(spec.lora, layer_w, "q_proj", h,
+                       qlinear(h, layer_w["q_proj"]), adapter_ids)
+        k = apply_lora(spec.lora, layer_w, "k_proj", h,
+                       qlinear(h, layer_w["k_proj"]), adapter_ids)
+        v = apply_lora(spec.lora, layer_w, "v_proj", h,
+                       qlinear(h, layer_w["v_proj"]), adapter_ids)
         if spec.qkv_bias:
             q = q + layer_w["q_bias"]
             k = k + layer_w["k_bias"]
@@ -519,6 +566,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
+    if spec.mla is None:
+        h = apply_lora(spec.lora, layer_w, "o_proj", attn_out, h, adapter_ids)
     if spec.o_bias:
         h = h + layer_w["o_bias"]
     if spec.sandwich_norm:
@@ -530,9 +579,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         h = moe_block(spec.moe, h, layer_w)
     else:
         act = ACT_FNS[spec.act]
-        inter = act(qlinear(h, layer_w["gate_proj"])) * qlinear(h, layer_w["up_proj"])
-        inter = _shard(inter, AXIS_DP, None, AXIS_MP)
-        h = qlinear(inter, layer_w["down_proj"])
+        gate = apply_lora(spec.lora, layer_w, "gate_proj", h,
+                          qlinear(h, layer_w["gate_proj"]), adapter_ids)
+        up = apply_lora(spec.lora, layer_w, "up_proj", h,
+                        qlinear(h, layer_w["up_proj"]), adapter_ids)
+        inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
+        h = apply_lora(spec.lora, layer_w, "down_proj", inter,
+                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
     hidden = hidden + _shard(h, AXIS_DP, None, None)
@@ -543,7 +596,8 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                seq_ids, positions, phase: str,
                identity_seq_ids: bool = False,
                arange_positions: bool = False,
-               slot_mapping=None, block_table=None):
+               slot_mapping=None, block_table=None,
+               adapter_ids=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -559,7 +613,8 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
             h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, ai, loc,
                                     seq_ids, positions, phase,
                                     identity_seq_ids, arange_positions,
-                                    slot_mapping, block_table, mlp_kind)
+                                    slot_mapping, block_table, mlp_kind,
+                                    adapter_ids)
             return h, (nk, nv)
         return body
 
@@ -606,7 +661,7 @@ def _lm_head(spec: DecoderSpec, params, hidden):
 
 def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids, seq_lens,
-                          sampling_params, rng):
+                          sampling_params, rng, adapter_ids=None):
     """Prefill graph (reference submodel tag ``context_encoding_model``).
 
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
@@ -621,7 +676,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     # shim builds them); chunked/offset prefill variants must pass False
     hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
                                    seq_ids, position_ids, "prefill",
-                                   arange_positions=True)
+                                   arange_positions=True,
+                                   adapter_ids=adapter_ids)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -641,7 +697,7 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids,
-                          sampling_params, rng):
+                          sampling_params, rng, adapter_ids=None):
     """Decode graph (reference submodel tag ``token_generation_model``).
 
     input_ids (B, T) with T = 1 (or speculation window).
@@ -652,7 +708,8 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
                                    seq_ids, position_ids, "decode",
-                                   identity_seq_ids=not tpu_cfg.is_continuous_batching)
+                                   identity_seq_ids=not tpu_cfg.is_continuous_batching,
+                                   adapter_ids=adapter_ids)
     logits = _lm_head(spec, params, hidden)
     out = {"cache": new_cache}
     if tpu_cfg.output_logits:
@@ -718,7 +775,7 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                 first_tokens, position_ids, seq_ids, sampling_params, rng,
-                num_steps: int):
+                num_steps: int, adapter_ids=None):
     """Fused multi-token decode: ``lax.scan`` of ``num_steps`` decode steps in
     ONE device call. This is the TPU answer to the reference's async
     double-buffering (modules/async_execution.py) — instead of hiding the
@@ -733,7 +790,8 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         tok, pos, cch = carry
         out = token_generation_step(
             spec, replace_output_logits(tpu_cfg), params, cch,
-            tok[:, None], pos[:, None], seq_ids, sampling_params, step_rng)
+            tok[:, None], pos[:, None], seq_ids, sampling_params, step_rng,
+            adapter_ids)
         nxt = out["tokens"]
         return (nxt, pos + 1, out["cache"]), nxt
 
@@ -815,6 +873,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         # dual-path structure, attention_base.py:985-1034)
         flash_prefill=bool(tcfg.attn_kernel_enabled),
         quant=quant_spec_from_config(tcfg),
+        lora=lora_spec_from_config(tcfg),
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)

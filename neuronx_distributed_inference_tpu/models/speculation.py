@@ -219,19 +219,8 @@ def eagle_draft_param_specs(draft_spec: DecoderSpec,
 
 def init_eagle_draft_params(draft_spec: DecoderSpec, key, mesh=None,
                             input_norm: bool = False):
-    import jax
-    from jax.sharding import NamedSharding
     specs = eagle_draft_param_specs(draft_spec, input_norm)
-    flat, treedef = jax.tree.flatten(
-        specs, is_leaf=lambda x: isinstance(x, ParamSpec))
-    keys = jax.random.split(key, len(flat))
-    leaves = []
-    for k, ps in zip(keys, flat):
-        x = ps.initializer(k)
-        if mesh is not None:
-            x = jax.device_put(x, NamedSharding(mesh, ps.pspec))
-        leaves.append(x)
-    return jax.tree.unflatten(treedef, leaves)
+    return model_base.init_param_tree(specs, key, mesh)
 
 
 def eagle_forward(draft_spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,

@@ -1,0 +1,147 @@
+"""Multi-LoRA serving (reference: modules/lora_serving/ — config 224,
+lora_checkpoint 412, lora_layer 358, lora_model 682, lora_module 208 LoC;
+SURVEY §2.6).
+
+TPU-native design: instead of swapping module classes (the reference's
+``LoraModel.inject_adapter``), every targeted projection carries stacked
+adapter weights
+
+    lora_A_<mod>: (L, max_loras, in, r)     lora_B_<mod>: (L, max_loras, r, out)
+
+and the per-request ``adapter_ids`` (B,) gather each row's adapter INSIDE the
+graph (reference: LoraWeightManager selecting by adapter_ids). The
+``lora_alpha/r`` scale is folded into B at load time. Slot 0 is conventionally
+the zero adapter (B=0 → base model behavior).
+
+Dynamic multi-LoRA (reference: models/model_base.py:3349-3356 host-side
+adapter swap) = writing a new adapter into a slot of the stacked arrays
+between requests (:func:`set_adapter_slot`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_TARGET_MODULES = ("q_proj", "v_proj")
+
+
+@dataclass(frozen=True)
+class LoraSpec:
+    """Static LoRA serving geometry (hashable, closed over by jit)."""
+
+    max_loras: int = 1
+    rank: int = 16
+    target_modules: Tuple[str, ...] = DEFAULT_TARGET_MODULES
+
+    def targets(self, name: str) -> bool:
+        return name in self.target_modules
+
+
+def lora_spec_from_config(tpu_config) -> Optional["LoraSpec"]:
+    lc = getattr(tpu_config, "lora_config", None)
+    if lc is None:
+        return None
+    return LoraSpec(
+        max_loras=lc.max_loras,
+        rank=lc.max_lora_rank,
+        target_modules=tuple(lc.target_modules or DEFAULT_TARGET_MODULES),
+    )
+
+
+def lora_delta(x: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
+               adapter_ids: jnp.ndarray) -> jnp.ndarray:
+    """Per-row adapter delta: x (B,T,in); a (max_loras,in,r);
+    b (max_loras,r,out) with scale folded in; adapter_ids (B,)."""
+    a_sel = a[adapter_ids].astype(jnp.float32)       # (B,in,r)
+    b_sel = b[adapter_ids].astype(jnp.float32)       # (B,r,out)
+    d = jnp.einsum("bti,bir->btr", x.astype(jnp.float32), a_sel)
+    d = jnp.einsum("btr,bro->bto", d, b_sel)
+    return d.astype(x.dtype)
+
+
+def apply_lora(spec_lora: Optional[LoraSpec], layer_w: Dict[str, Any],
+               name: str, x: jnp.ndarray, y: jnp.ndarray,
+               adapter_ids) -> jnp.ndarray:
+    """y = base(x) plus this module's adapter delta when serving LoRA."""
+    if (spec_lora is None or adapter_ids is None
+            or not spec_lora.targets(name)):
+        return y
+    return y + lora_delta(x, layer_w[f"lora_A_{name}"],
+                          layer_w[f"lora_B_{name}"], adapter_ids)
+
+
+# ---------------------------------------------------------------------------
+# PEFT checkpoint loading (reference: lora_checkpoint.py)
+# ---------------------------------------------------------------------------
+
+def load_peft_adapter(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load a PEFT-format adapter dir: adapter_config.json +
+    adapter_model.safetensors (or .bin). Returns (state_dict, config)."""
+    with open(os.path.join(path, "adapter_config.json")) as f:
+        cfg = json.load(f)
+    from ..utils.checkpoint import _load_one
+    for fname in ("adapter_model.safetensors", "adapter_model.bin"):
+        p = os.path.join(path, fname)
+        if os.path.exists(p):
+            return _load_one(p), cfg
+    raise FileNotFoundError(f"no adapter weights under {path}")
+
+
+def adapter_layer_arrays(sd: Dict[str, np.ndarray], cfg: Dict[str, Any],
+                         num_layers: int, module: str, in_dim: int,
+                         out_dim: int, max_rank: int,
+                         out_transform=None,
+                         in_transform=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Stack one module's A/B across layers from a PEFT state dict, padding
+    rank to ``max_rank`` (zero-padded rank columns are exact no-ops) and
+    folding alpha/r into B. out_transform / in_transform: head pad/replicate
+    hooks applied to B's out axis / A's in axis (same GQA transforms as the
+    base weights, gqa.py:679+).
+
+    Returns A (L, in, max_rank), B (L, max_rank, out).
+    """
+    r = int(cfg.get("r", max_rank))
+    alpha = float(cfg.get("lora_alpha", r))
+    scale = alpha / r
+    a_out = np.zeros((num_layers, in_dim, max_rank), np.float32)
+    b_out = np.zeros((num_layers, max_rank, out_dim), np.float32)
+    found = False
+    for i in range(num_layers):
+        cand_a = [k for k in sd if f"layers.{i}." in k and module in k
+                  and "lora_A" in k]
+        cand_b = [k for k in sd if f"layers.{i}." in k and module in k
+                  and "lora_B" in k]
+        if not cand_a:
+            continue
+        found = True
+        a = np.asarray(sd[cand_a[0]], np.float32)     # torch layout (r, in)
+        b = np.asarray(sd[cand_b[0]], np.float32)     # (out, r)
+        at = np.ascontiguousarray(a.T)                # (in, r)
+        bt = np.ascontiguousarray(b.T) * scale        # (r, out)
+        if in_transform is not None:
+            at = in_transform(at)
+        if out_transform is not None:
+            bt = out_transform(bt)
+        a_out[i, :at.shape[0], :at.shape[1]] = at
+        b_out[i, :bt.shape[0], :bt.shape[1]] = bt
+    if not found:
+        raise KeyError(f"adapter has no weights for module {module!r}")
+    return a_out, b_out
+
+
+def set_adapter_slot(params: Dict[str, Any], layers_key: str, slot: int,
+                     module: str, a: np.ndarray, b: np.ndarray) -> None:
+    """Dynamic multi-LoRA: write adapter (a, b) into ``slot`` of the stacked
+    device arrays in-place (functional update on the param tree)."""
+    lw = params[layers_key]
+    lw[f"lora_A_{module}"] = lw[f"lora_A_{module}"].at[:, slot].set(
+        jnp.asarray(a, lw[f"lora_A_{module}"].dtype))
+    lw[f"lora_B_{module}"] = lw[f"lora_B_{module}"].at[:, slot].set(
+        jnp.asarray(b, lw[f"lora_B_{module}"].dtype))
