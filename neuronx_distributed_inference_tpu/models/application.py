@@ -150,7 +150,8 @@ class CausalLMApplication:
             v_head_dim=(self.spec.v_head_dim
                         if self.spec.v_head_dim != self.spec.head_dim else None),
         )
-        self.cache = init_cache(spec, self.mesh)
+        self.cache = init_cache(spec, self.mesh,
+                                flash_decoding=self.spec.flash_decoding)
         return self
 
     # ------------------------------------------------------------------

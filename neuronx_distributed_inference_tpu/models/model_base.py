@@ -147,6 +147,18 @@ class DecoderSpec:
     # multi-LoRA serving (reference: modules/lora_serving/): stacked
     # per-adapter A/B weights selected by per-request adapter_ids
     lora: Optional[LoraSpec] = None
+    # --- scale-out (reference: SURVEY §2.8 parallelism inventory) ---
+    # SP: shard prefill activations on seq over the "cp" axis between blocks
+    # (reference: sequence_parallel_enabled, model_base.py:1482-1517)
+    seq_parallel: bool = False
+    # CP prefill: Q stays seq-sharded over "cp", KV replicated on seq so XLA
+    # inserts the all-gather — the reference's all-gather-KV CP strategy
+    # (attention_base.py:548-563), not ring attention
+    cp_prefill: bool = False
+    # flash decoding: KV cache seq dim sharded over "cp"; decode scores and
+    # softmax are computed distributed over the seq shards (reference:
+    # modules/flashdecode/utils.py decode-time S-sharding)
+    flash_decoding: bool = False
     # weight-only quantization (reference: models/config.py:216-241); the
     # param tree then carries {"qweight","scale"} leaf-groups for the
     # converted weights (modules/quantization.py)
@@ -499,7 +511,12 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
             q = jnp.clip(q, -spec.qkv_clip, spec.qkv_clip)
             k = jnp.clip(k, -spec.qkv_clip, spec.qkv_clip)
             v = jnp.clip(v, -spec.qkv_clip, spec.qkv_clip)
-        q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
+        # CP prefill: Q seq-sharded over "cp", KV forced seq-replicated —
+        # GSPMD then emits the all-gather-KV pattern of the reference
+        # (attention_base.py:548-563)
+        q_seq_axis = AXIS_CP if (spec.cp_prefill and phase == "prefill") else None
+        q = _shard(_split_heads(q, g.num_q_heads, spec.head_dim),
+                   AXIS_DP, q_seq_axis, AXIS_MP, None)
         k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
         v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
         if spec.qk_norm:
@@ -572,7 +589,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         h = h + layer_w["o_bias"]
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
-    hidden = hidden + _shard(h, AXIS_DP, None, None)
+    # SP: residual stream stays seq-sharded between blocks during prefill
+    # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
+    sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
+    hidden = hidden + _shard(h, AXIS_DP, sp_axis, None)
 
     h = _norm(spec, hidden, layer_w["post_norm"])
     if mlp_kind == "moe":
@@ -588,7 +608,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                        qlinear(inter, layer_w["down_proj"]), adapter_ids)
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
-    hidden = hidden + _shard(h, AXIS_DP, None, None)
+    hidden = hidden + _shard(h, AXIS_DP, sp_axis, None)
     return hidden, new_k, new_v
 
 
@@ -672,6 +692,10 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
     hidden = _embed(spec, params, input_ids)
+    if spec.seq_parallel:
+        # SP: shard the embedded sequence (reference: reduce-scatter of
+        # embeddings, model_base.py:1482-1517)
+        hidden = _shard(hidden, AXIS_DP, AXIS_CP, None)
     # context_encoding_step always feeds arange positions per row (the host
     # shim builds them); chunked/offset prefill variants must pass False
     hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
@@ -874,6 +898,9 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         flash_prefill=bool(tcfg.attn_kernel_enabled),
         quant=quant_spec_from_config(tcfg),
         lora=lora_spec_from_config(tcfg),
+        seq_parallel=bool(tcfg.sequence_parallel_enabled),
+        cp_prefill=tcfg.cp_degree > 1,
+        flash_decoding=bool(tcfg.flash_decoding_enabled),
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)

@@ -66,16 +66,23 @@ class KVCacheSpec:
         return self.shape[:-1] + (self.v_dim,)
 
 
-def cache_pspec() -> P:
-    return P(None, AXIS_DP, None, AXIS_MP, None)
+def cache_pspec(flash_decoding: bool = False) -> P:
+    """Cache layout (L, B, S, H, D). Flash decoding shards S over the "cp"
+    axis — the decode-time sequence sharding of the reference
+    (modules/flashdecode/utils.py): each cp rank holds a slice of every
+    sequence's KV; GSPMD turns the decode softmax into the distributed
+    max/sum + psum pattern automatically."""
+    from ..parallel.mesh import AXIS_CP
+    return P(None, AXIS_DP, AXIS_CP if flash_decoding else None, AXIS_MP, None)
 
 
-def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None):
+def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None,
+               flash_decoding: bool = False):
     """Zero-initialized {'k','v'} cache, device-placed with the cache sharding."""
     def zeros(shape):
         x = jnp.zeros(shape, spec.dtype)
         if mesh is not None:
-            x = jax.device_put(x, NamedSharding(mesh, cache_pspec()))
+            x = jax.device_put(x, NamedSharding(mesh, cache_pspec(flash_decoding)))
         return x
 
     return {"k": zeros(spec.shape), "v": zeros(spec.v_shape)}
