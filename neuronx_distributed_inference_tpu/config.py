@@ -143,12 +143,47 @@ class SpeculationConfig:
         return dataclasses.asdict(self)
 
 
+@dataclass
+class TensorCaptureConfig:
+    """Intermediate-tensor capture appended to graph outputs
+    (reference: models/config.py:1121-1169 + utils/tensor_capture_utils.py).
+
+    capture_targets: per-layer points — "layer_output", "attn_output",
+    "mlp_output" (stacked (L, B, T, H) in the step output under
+    ``captured``)."""
+
+    capture_targets: List[str] = field(
+        default_factory=lambda: ["layer_output"])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class TensorReplacementConfig:
+    """Feed golden tensors into chosen layer points for fault localization
+    (reference: models/config.py:1172-1202 + utils/tensor_replacement/).
+
+    targets: point names (same vocabulary as capture); source_path: .npz
+    with one array per target, shaped (L, B, T, H); layers: which layer
+    indices to replace (None = all layers present in the arrays)."""
+
+    targets: List[str] = field(default_factory=list)
+    source_path: Optional[str] = None
+    layers: Optional[List[int]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
 _SUBCONFIG_TYPES = {
     "on_device_sampling_config": OnDeviceSamplingConfig,
     "chunked_prefill_config": ChunkedPrefillConfig,
     "moe_config": MoEConfig,
     "lora_config": LoraServingConfig,
     "speculation_config": SpeculationConfig,
+    "tensor_capture_config": TensorCaptureConfig,
+    "tensor_replacement_config": TensorReplacementConfig,
 }
 
 
@@ -228,6 +263,10 @@ class TpuConfig:
 
     # --- chunked prefill ---
     chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
+
+    # --- observability (reference: models/config.py:320-353) ---
+    tensor_capture_config: Optional[TensorCaptureConfig] = None
+    tensor_replacement_config: Optional[TensorReplacementConfig] = None
 
     # --- quantization (reference: models/config.py:216-241) ---
     quantized: bool = False

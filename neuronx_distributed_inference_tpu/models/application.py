@@ -68,6 +68,13 @@ class CausalLMApplication:
         self._rng = jax.random.PRNGKey(self.tpu_config.seed)
         self.ctx_buckets = autobucketing.context_encoding_buckets(self.tpu_config)
         self.tkg_buckets = autobucketing.token_generation_buckets(self.tpu_config)
+        # observability (reference: utils/snapshot.py env-driven capture;
+        # utils/tensor_replacement/ golden injection)
+        from ..utils.snapshot import SnapshotManager
+        self.snapshot = SnapshotManager()
+        self.replacements = None
+        if self.tpu_config.tensor_replacement_config is not None:
+            self.load_tensor_replacements()
         if self.tpu_config.compile_cache_dir:
             jax.config.update("jax_compilation_cache_dir",
                               self.tpu_config.compile_cache_dir)
@@ -245,10 +252,16 @@ class CausalLMApplication:
         fn = self.get_compiled(CONTEXT_ENCODING_MODEL_TAG, s)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
+        if self.snapshot.enabled:
+            self.snapshot.save_step({"input_ids": input_ids,
+                                     "position_ids": position_ids,
+                                     "seq_ids": seq_ids,
+                                     "seq_lens": seq_lens},
+                                    weights=self.params)
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
                  jnp.asarray(seq_lens), sampling_params, self._next_rng(),
-                 adapter_ids)
+                 adapter_ids, self.replacements)
         self.cache = out["cache"]
         return out
 
@@ -268,9 +281,14 @@ class CausalLMApplication:
         fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
+        if self.snapshot.enabled:
+            self.snapshot.save_step({"input_ids": input_ids,
+                                     "position_ids": position_ids,
+                                     "seq_ids": seq_ids})
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
-                 sampling_params, self._next_rng(), adapter_ids)
+                 sampling_params, self._next_rng(), adapter_ids,
+                 self.replacements)
         self.cache = out["cache"]
         return out
 
@@ -324,6 +342,8 @@ class CausalLMApplication:
             raise RuntimeError("load_weights() or init_random_weights() first")
         if sampling_params is not None:
             sampling_params = jnp.asarray(sampling_params)
+        if self.snapshot.enabled:
+            self.snapshot.on_request()
 
         if teacher_tokens is not None:
             # teacher forcing can feed at most T tokens, producing T+1 steps
@@ -397,6 +417,37 @@ class CausalLMApplication:
     def reset(self):
         """Clear KV cache between requests."""
         self.init_cache()
+        return self
+
+    # ------------------------------------------------------------------
+    # observability (reference: SURVEY §5)
+    # ------------------------------------------------------------------
+    def load_tensor_replacements(self, source_path: Optional[str] = None):
+        """Build the golden-injection arrays from the configured .npz
+        (reference: utils/tensor_replacement/registry.py + wiring
+        model_wrapper.py:481-518). The npz holds one (L,B,T,H) array per
+        target point; ``layers`` restricts which layer indices replace."""
+        trc = self.tpu_config.tensor_replacement_config
+        path = source_path or (trc.source_path if trc else None)
+        if path is None:
+            raise ValueError("tensor_replacement_config.source_path required")
+        data = np.load(path)
+        L = self.spec.num_layers
+        layer_on = np.zeros((L,), bool)
+        if trc and trc.layers is not None:
+            layer_on[np.asarray(trc.layers, int)] = True
+        else:
+            layer_on[:] = True
+        rep: Dict[str, Any] = {}
+        targets = (trc.targets if trc and trc.targets else list(data.files))
+        for name in targets:
+            arr = np.asarray(data[name])
+            if arr.shape[0] != L:
+                raise ValueError(f"replacement {name!r} leading dim "
+                                 f"{arr.shape[0]} != num_layers {L}")
+            rep[name] = jnp.asarray(arr)
+            rep[name + "_on"] = jnp.asarray(layer_on)
+        self.replacements = rep
         return self
 
     # ------------------------------------------------------------------

@@ -147,6 +147,9 @@ class DecoderSpec:
     # multi-LoRA serving (reference: modules/lora_serving/): stacked
     # per-adapter A/B weights selected by per-request adapter_ids
     lora: Optional[LoraSpec] = None
+    # intermediate-tensor capture points appended to graph outputs
+    # (reference: models/model_base.py:1076-1149 tensor capture)
+    capture: Optional[Tuple[str, ...]] = None
     # --- scale-out (reference: SURVEY §2.8 parallelism inventory) ---
     # SP: shard prefill activations on seq over the "cp" axis between blocks
     # (reference: sequence_parallel_enabled, model_base.py:1482-1517)
@@ -462,7 +465,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
-                adapter_ids=None):
+                adapter_ids=None, replace=None):
     """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D) — or, in
     the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
     ``block_table`` set (phase "paged", reference:
@@ -486,6 +489,18 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     off = spec.norm_offset
     if mlp_kind is None:
         mlp_kind = "dense" if spec.moe is None else "moe"
+    caps: Dict[str, Any] = {}
+
+    def _tap(name, val):
+        """Tensor replacement (golden injection) then capture at one point
+        (reference: utils/tensor_replacement/ + tensor capture
+        model_base.py:1076-1149)."""
+        if replace is not None and name in replace:
+            val = jnp.where(replace[name + "_on"],
+                            replace[name].astype(val.dtype), val)
+        if spec.capture and name in spec.capture:
+            caps[name] = val
+        return val
     if "cos_l" in ai:
         cos = jnp.where(is_local, ai["cos_l"], ai["cos"])
         sin = jnp.where(is_local, ai["sin_l"], ai["sin"])
@@ -589,6 +604,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
         h = h + layer_w["o_bias"]
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
+    h = _tap("attn_output", h)
     # SP: residual stream stays seq-sharded between blocks during prefill
     # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
     sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
@@ -608,8 +624,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
                        qlinear(inter, layer_w["down_proj"]), adapter_ids)
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
+    h = _tap("mlp_output", h)
     hidden = hidden + _shard(h, AXIS_DP, sp_axis, None)
-    return hidden, new_k, new_v
+    hidden = _tap("layer_output", hidden)
+    return hidden, new_k, new_v, caps
 
 
 def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
@@ -617,45 +635,55 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                identity_seq_ids: bool = False,
                arange_positions: bool = False,
                slot_mapping=None, block_table=None,
-               adapter_ids=None):
+               adapter_ids=None, replacements=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
     (models/model_base.py:1216-1469 get_model_output).
-    ai: attn_inputs() bundle. Returns (hidden, new_cache).
+    ai: attn_inputs() bundle; replacements: {point: (L,B,T,H),
+    point+"_on": (L,)} golden-injection arrays.
+    Returns (hidden, new_cache, captured) — captured = {} unless
+    spec.capture names per-layer points (then each is stacked (L, ...)).
     """
     is_local = jnp.asarray(spec.layer_pattern if spec.layer_pattern is not None
                            else (False,) * spec.num_layers)
+    rep = replacements or {}
 
     def make_body(mlp_kind):
         def body(carry, xs):
-            layer_w, kc, vc, loc = xs
-            h, nk, nv = _layer_body(spec, carry, layer_w, kc, vc, ai, loc,
-                                    seq_ids, positions, phase,
-                                    identity_seq_ids, arange_positions,
-                                    slot_mapping, block_table, mlp_kind,
-                                    adapter_ids)
-            return h, (nk, nv)
+            layer_w, kc, vc, loc, rp = xs
+            h, nk, nv, caps = _layer_body(
+                spec, carry, layer_w, kc, vc, ai, loc, seq_ids, positions,
+                phase, identity_seq_ids, arange_positions, slot_mapping,
+                block_table, mlp_kind, adapter_ids,
+                rp if replacements is not None else None)
+            return h, (nk, nv, caps)
         return body
+
+    def sl(lo, hi):
+        return jax.tree.map(lambda a: a[lo:hi], rep)
 
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans over one contiguous cache
         nd = spec.first_dense
-        hidden, (k1, v1) = jax.lax.scan(
+        L = spec.num_layers
+        hidden, (k1, v1, c1) = jax.lax.scan(
             make_body("dense"), hidden,
-            (params["layers"], cache["k"][:nd], cache["v"][:nd], is_local[:nd]))
-        hidden, (k2, v2) = jax.lax.scan(
+            (params["layers"], cache["k"][:nd], cache["v"][:nd],
+             is_local[:nd], sl(0, nd)))
+        hidden, (k2, v2, c2) = jax.lax.scan(
             make_body("moe"), hidden,
             (params["moe_layers"], cache["k"][nd:], cache["v"][nd:],
-             is_local[nd:]))
+             is_local[nd:], sl(nd, L)))
+        caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
         return hidden, {"k": jnp.concatenate([k1, k2]),
-                        "v": jnp.concatenate([v1, v2])}
+                        "v": jnp.concatenate([v1, v2])}, caps
 
-    hidden, (new_k, new_v) = jax.lax.scan(
+    hidden, (new_k, new_v, caps) = jax.lax.scan(
         make_body(None), hidden,
-        (params["layers"], cache["k"], cache["v"], is_local))
-    return hidden, {"k": new_k, "v": new_v}
+        (params["layers"], cache["k"], cache["v"], is_local, rep))
+    return hidden, {"k": new_k, "v": new_v}, caps
 
 
 # ---------------------------------------------------------------------------
@@ -681,7 +709,8 @@ def _lm_head(spec: DecoderSpec, params, hidden):
 
 def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids, seq_lens,
-                          sampling_params, rng, adapter_ids=None):
+                          sampling_params, rng, adapter_ids=None,
+                          replacements=None):
     """Prefill graph (reference submodel tag ``context_encoding_model``).
 
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
@@ -698,10 +727,11 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         hidden = _shard(hidden, AXIS_DP, AXIS_CP, None)
     # context_encoding_step always feeds arange positions per row (the host
     # shim builds them); chunked/offset prefill variants must pass False
-    hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
-                                   seq_ids, position_ids, "prefill",
-                                   arange_positions=True,
-                                   adapter_ids=adapter_ids)
+    hidden, new_cache, caps = run_layers(spec, params, cache, hidden, ai,
+                                         seq_ids, position_ids, "prefill",
+                                         arange_positions=True,
+                                         adapter_ids=adapter_ids,
+                                         replacements=replacements)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -714,6 +744,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         out["logits"] = full_logits[..., :spec.vocab_size]
     if tpu_cfg.output_full_hidden:
         out["hidden_states"] = hidden
+    if caps:
+        out["captured"] = caps
     out["tokens"] = sampling_ops.sample(
         logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
@@ -721,7 +753,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids,
-                          sampling_params, rng, adapter_ids=None):
+                          sampling_params, rng, adapter_ids=None,
+                          replacements=None):
     """Decode graph (reference submodel tag ``token_generation_model``).
 
     input_ids (B, T) with T = 1 (or speculation window).
@@ -730,12 +763,14 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
         position_ids, cache_len, window=w))
     hidden = _embed(spec, params, input_ids)
-    hidden, new_cache = run_layers(spec, params, cache, hidden, ai,
-                                   seq_ids, position_ids, "decode",
-                                   identity_seq_ids=not tpu_cfg.is_continuous_batching,
-                                   adapter_ids=adapter_ids)
+    hidden, new_cache, caps = run_layers(
+        spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
+        identity_seq_ids=not tpu_cfg.is_continuous_batching,
+        adapter_ids=adapter_ids, replacements=replacements)
     logits = _lm_head(spec, params, hidden)
     out = {"cache": new_cache}
+    if caps:
+        out["captured"] = caps
     if tpu_cfg.output_logits:
         out["logits"] = logits[..., :spec.vocab_size]
     out["tokens"] = sampling_ops.sample(
@@ -754,7 +789,7 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
         position_ids, cache_len, window=w))
     hidden = _embed(spec, params, input_ids)
-    hidden, new_cache = run_layers(
+    hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids,
         "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = _lm_head(spec, params, hidden)
@@ -783,7 +818,7 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
         position_ids, kv_len, window=w))
     hidden = _embed(spec, params, input_ids)
-    hidden, new_cache = run_layers(
+    hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
         "paged", slot_mapping=slot_mapping, block_table=block_table)
     idx = last_idx[:, None, None].astype(jnp.int32)
@@ -901,6 +936,8 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         seq_parallel=bool(tcfg.sequence_parallel_enabled),
         cp_prefill=tcfg.cp_degree > 1,
         flash_decoding=bool(tcfg.flash_decoding_enabled),
+        capture=(tuple(tcfg.tensor_capture_config.capture_targets)
+                 if tcfg.tensor_capture_config else None),
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)

@@ -241,7 +241,7 @@ def eagle_forward(draft_spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     ai = model_base.attn_inputs(
         draft_spec, positions,
         lambda w: attn_ops.decode_mask(positions, cache_len, window=w))
-    hidden, new_cache = model_base.run_layers(
+    hidden, new_cache, _ = model_base.run_layers(
         draft_spec, params, cache, h0, ai, seq_ids, positions, "decode",
         identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = model_base._lm_head(draft_spec, params, hidden)
@@ -546,7 +546,7 @@ def tree_forward(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     from ..ops.rope import rope_cos_sin
     ai["cos"], ai["sin"] = rope_cos_sin(rope_positions, spec.rope)
     hidden = model_base._embed(spec, params, node_tokens)
-    hidden, new_cache = model_base.run_layers(
+    hidden, new_cache, _ = model_base.run_layers(
         spec, params, cache, hidden, ai, seq_ids, write_positions, "decode",
         identity_seq_ids=not tpu_cfg.is_continuous_batching)
     logits = model_base._lm_head(spec, params, hidden)

@@ -1,0 +1,66 @@
+"""Debug utilities — input capture + auto-capture on logit divergence
+(reference: utils/debug_utils.py:11-90 input-capture hook with auto-capture
+when logits diverge, wiring inference_demo.py:616-649; _log_input
+models/model_base.py:3506)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+logger = logging.getLogger("nxdi_tpu")
+
+CAPTURE_DIR_ENV = "NXDI_TPU_DEBUG_CAPTURE_DIR"
+
+
+def capture_inputs(path: str, tag: str, **arrays) -> str:
+    """Save a set of named arrays as one .npz (reference: input-capture hook
+    saving CTE/TKG inputs at chosen token indices)."""
+    os.makedirs(path, exist_ok=True)
+    f = os.path.join(path, f"{tag}.npz")
+    np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()
+                   if v is not None})
+    logger.info("debug: captured %s", f)
+    return f
+
+
+def check_divergence(actual: np.ndarray, golden: np.ndarray,
+                     divergence_tol: float = 1e-3,
+                     capture_dir: Optional[str] = None,
+                     tag: str = "divergence",
+                     inputs: Optional[Dict[str, Any]] = None) -> Optional[int]:
+    """Return the first index (flattened over leading dims) where
+    |actual-golden| exceeds the tolerance, else None. On divergence, when a
+    capture dir is set (arg or $NXDI_TPU_DEBUG_CAPTURE_DIR), dump both
+    tensors (+ inputs) for offline triage — the reference's auto-capture on
+    logit divergence."""
+    actual = np.asarray(actual, np.float32)
+    golden = np.asarray(golden, np.float32)
+    err = np.abs(actual - golden)
+    bad = err > (divergence_tol + divergence_tol * np.abs(golden))
+    if not bad.any():
+        return None
+    idx = int(np.argwhere(bad.reshape(bad.shape[0], -1).any(axis=1))[0, 0])
+    capture_dir = capture_dir or os.environ.get(CAPTURE_DIR_ENV)
+    if capture_dir:
+        payload = {"actual": actual, "golden": golden}
+        if inputs:
+            payload.update(inputs)
+        capture_inputs(capture_dir, f"{tag}_idx{idx}", **payload)
+    logger.warning("divergence at index %d: max err %.5f", idx,
+                   float(err.max()))
+    return idx
+
+
+def log_inputs(tag: str, **arrays) -> None:
+    """Compact input logging (reference: _log_input)."""
+    parts = []
+    for k, v in arrays.items():
+        if v is None:
+            continue
+        v = np.asarray(v)
+        parts.append(f"{k}: shape={v.shape} dtype={v.dtype}")
+    logger.debug("%s inputs | %s", tag, " | ".join(parts))

@@ -1,0 +1,142 @@
+"""Snapshot system — env-var-driven capture of model inputs (+ weights) at
+chosen requests/tokens (reference: utils/snapshot.py:234-448, registration
+application_base.py:423-554; env vars NXD_INFERENCE_CAPTURE_SNAPSHOT*).
+
+TPU version: no TorchScript hooks needed — the application calls
+``SnapshotManager.save`` at the two host->device boundaries (prefill /
+decode) with the exact arrays being fed to the jitted graph.
+
+Env vars (reference names accepted with the NXDI_TPU prefix too):
+  NXDI_TPU_CAPTURE_SNAPSHOT=1         enable
+  NXDI_TPU_SNAPSHOT_OUTPUT_PATH=dir   output root (default ./snapshots)
+  NXDI_TPU_SNAPSHOT_FORMAT=npy|pickle
+  NXDI_TPU_SNAPSHOT_AT_REQUESTS=0,2   request indices to capture
+  NXDI_TPU_SNAPSHOT_FOR_TOKENS=0,1    token indices (0 = prefill)
+  NXDI_TPU_SNAPSHOT_WEIGHTS=1         also dump the weights once
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("nxdi_tpu")
+
+_PREFIXES = ("NXDI_TPU", "NXD_INFERENCE")
+
+
+def _env(suffix: str) -> Optional[str]:
+    for p in _PREFIXES:
+        v = os.environ.get(f"{p}_{suffix}")
+        if v is not None:
+            return v
+    return None
+
+
+def _int_list(s: Optional[str]) -> Optional[List[int]]:
+    if not s:
+        return None
+    return [int(x) for x in s.split(",") if x.strip() != ""]
+
+
+@dataclass
+class SnapshotConfig:
+    enabled: bool = False
+    output_path: str = "snapshots"
+    fmt: str = "npy"                       # "npy" | "pickle"
+    at_requests: Optional[List[int]] = None   # None = every request
+    for_tokens: Optional[List[int]] = None    # None = every token; 0=prefill
+    capture_weights: bool = False
+
+    @classmethod
+    def from_env(cls) -> "SnapshotConfig":
+        return cls(
+            enabled=_env("CAPTURE_SNAPSHOT") in ("1", "true", "True"),
+            output_path=_env("SNAPSHOT_OUTPUT_PATH") or "snapshots",
+            fmt=(_env("SNAPSHOT_FORMAT") or "npy"),
+            at_requests=_int_list(_env("SNAPSHOT_AT_REQUESTS")),
+            for_tokens=_int_list(_env("SNAPSHOT_FOR_TOKENS")),
+            capture_weights=_env("SNAPSHOT_WEIGHTS") in ("1", "true", "True"),
+        )
+
+
+class SnapshotManager:
+    """Tracks (request, token) indices and writes matching snapshots."""
+
+    def __init__(self, cfg: Optional[SnapshotConfig] = None):
+        self.cfg = cfg or SnapshotConfig.from_env()
+        self.request_idx = -1
+        self.token_idx = 0          # 0 = prefill, then one per decode step
+        self._weights_saved = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def on_request(self):
+        self.request_idx += 1
+        self.token_idx = 0
+
+    def save_step(self, tensors: Dict[str, Any],
+                  weights: Optional[Dict[str, Any]] = None):
+        """Save at the current token index, then advance it."""
+        self.save(self.token_idx, tensors, weights)
+        self.token_idx += 1
+
+    def should(self, token_idx: int) -> bool:
+        c = self.cfg
+        if not c.enabled:
+            return False
+        if c.at_requests is not None and self.request_idx not in c.at_requests:
+            return False
+        if c.for_tokens is not None and token_idx not in c.for_tokens:
+            return False
+        return True
+
+    def save(self, token_idx: int, tensors: Dict[str, Any],
+             weights: Optional[Dict[str, Any]] = None):
+        """Write one snapshot if (request, token) matches the config."""
+        if not self.should(token_idx):
+            return
+        d = os.path.join(self.cfg.output_path,
+                         f"request_{self.request_idx}", f"token_{token_idx}")
+        os.makedirs(d, exist_ok=True)
+        arrays = {k: np.asarray(v) for k, v in tensors.items()
+                  if v is not None}
+        if self.cfg.fmt == "pickle":
+            with open(os.path.join(d, "inputs.pkl"), "wb") as f:
+                pickle.dump(arrays, f)
+        else:
+            for k, v in arrays.items():
+                np.save(os.path.join(d, f"{k}.npy"), v)
+        logger.info("snapshot: captured %d tensors at request %d token %d",
+                    len(arrays), self.request_idx, token_idx)
+        if (self.cfg.capture_weights and weights is not None
+                and not self._weights_saved):
+            wd = os.path.join(self.cfg.output_path, "weights")
+            os.makedirs(wd, exist_ok=True)
+            flat = _flatten(weights)
+            if self.cfg.fmt == "pickle":
+                with open(os.path.join(wd, "weights.pkl"), "wb") as f:
+                    pickle.dump({k: np.asarray(v) for k, v in flat.items()}, f)
+            else:
+                for k, v in flat.items():
+                    np.save(os.path.join(wd, f"{k.replace('/', '_')}.npy"),
+                            np.asarray(v))
+            self._weights_saved = True
+
+
+def _flatten(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "/"))
+        else:
+            out[key] = v
+    return out

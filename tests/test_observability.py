@@ -1,0 +1,144 @@
+"""Observability tests: tensor capture, tensor replacement, snapshots,
+divergence capture, profiler (reference: SURVEY §5 — utils/snapshot.py,
+utils/tensor_capture_utils.py, utils/tensor_replacement/,
+utils/debug_utils.py, utils/profiling.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (TensorCaptureConfig,
+                                                      TensorReplacementConfig,
+                                                      TpuConfig)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.parallel.mesh import (MeshConfig,
+                                                             build_mesh)
+from neuronx_distributed_inference_tpu.utils.snapshot import (SnapshotConfig,
+                                                              SnapshotManager)
+from neuronx_distributed_inference_tpu.utils import debug as debug_utils
+
+from conftest import tiny_llama_hf_config
+
+
+def _app(**tcfg_over):
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     enable_bucketing=False, output_logits=True, **tcfg_over)
+    icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+    app = CausalLMApplication(None, icfg, LlamaFamily,
+                              mesh=build_mesh(MeshConfig(tp=1)))
+    app.init_random_weights(seed=0)
+    app.init_cache()
+    return app
+
+
+def test_tensor_capture_shapes(rng):
+    app = _app(tensor_capture_config=TensorCaptureConfig(
+        capture_targets=["layer_output", "attn_output"]))
+    ids = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    out = app._run_prefill(ids, np.full((2,), 8, np.int32))
+    caps = out["captured"]
+    L, H = app.spec.num_layers, app.spec.hidden_size
+    assert set(caps) == {"layer_output", "attn_output"}
+    assert caps["layer_output"].shape == (L, 2, 8, H)
+    # decode step captures too
+    o = app._run_decode(np.zeros((2, 1), np.int32),
+                        np.full((2, 1), 8, np.int32))
+    assert o["captured"]["attn_output"].shape == (L, 2, 1, H)
+
+
+def test_tensor_capture_feeds_replacement_roundtrip(tmp_path, rng):
+    """Capture layer tensors, replay them through tensor replacement —
+    outputs must be identical (the golden-injection path is exact)."""
+    ids = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    lens = np.full((2,), 8, np.int32)
+    cap_app = _app(tensor_capture_config=TensorCaptureConfig(
+        capture_targets=["attn_output"]))
+    out = cap_app._run_prefill(ids, lens)
+    base_logits = np.asarray(out["logits"])
+    np.savez(tmp_path / "golden.npz",
+             attn_output=np.asarray(out["captured"]["attn_output"]))
+
+    rep_app = _app(tensor_replacement_config=TensorReplacementConfig(
+        targets=["attn_output"], source_path=str(tmp_path / "golden.npz")))
+    assert rep_app.replacements is not None
+    out2 = rep_app._run_prefill(ids, lens)
+    np.testing.assert_allclose(np.asarray(out2["logits"]), base_logits,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_tensor_replacement_subset_of_layers(tmp_path, rng):
+    """Replacing only some layers with zeros changes the output (and the
+    layer mask is honored — replacing zero layers is a no-op)."""
+    ids = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    lens = np.full((2,), 8, np.int32)
+    app = _app()
+    base = np.asarray(app._run_prefill(ids, lens)["logits"])
+    L, H = app.spec.num_layers, app.spec.hidden_size
+    np.savez(tmp_path / "zeros.npz",
+             attn_output=np.zeros((L, 2, 8, H), np.float32))
+
+    noop = _app(tensor_replacement_config=TensorReplacementConfig(
+        targets=["attn_output"], source_path=str(tmp_path / "zeros.npz"),
+        layers=[]))
+    np.testing.assert_allclose(
+        np.asarray(noop._run_prefill(ids, lens)["logits"]), base,
+        rtol=1e-5, atol=1e-5)
+
+    zap = _app(tensor_replacement_config=TensorReplacementConfig(
+        targets=["attn_output"], source_path=str(tmp_path / "zeros.npz"),
+        layers=[0, 1]))
+    assert not np.allclose(
+        np.asarray(zap._run_prefill(ids, lens)["logits"]), base)
+
+
+def test_snapshot_capture(tmp_path, rng):
+    cfg = SnapshotConfig(enabled=True, output_path=str(tmp_path / "snaps"),
+                         fmt="npy", at_requests=[0], for_tokens=[0, 2],
+                         capture_weights=True)
+    app = _app()
+    app.snapshot = SnapshotManager(cfg)
+    ids = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    app.generate(ids, max_new_tokens=4)
+    root = tmp_path / "snaps"
+    assert (root / "request_0" / "token_0" / "input_ids.npy").exists()
+    assert (root / "request_0" / "token_2" / "input_ids.npy").exists()
+    assert not (root / "request_0" / "token_1").exists()
+    assert (root / "weights").exists()
+    # request 1 not in at_requests -> nothing captured
+    app.reset()
+    app.generate(ids, max_new_tokens=2)
+    assert not (root / "request_1").exists()
+    tok0 = np.load(root / "request_0" / "token_0" / "input_ids.npy")
+    assert tok0.shape[0] == 2
+
+
+def test_divergence_capture(tmp_path):
+    golden = np.zeros((2, 4), np.float32)
+    ok = debug_utils.check_divergence(golden, golden, 1e-3)
+    assert ok is None
+    bad = golden.copy()
+    bad[1, 2] = 1.0
+    idx = debug_utils.check_divergence(bad, golden, 1e-3,
+                                       capture_dir=str(tmp_path), tag="t")
+    assert idx == 1
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("t_idx1") for f in files)
+
+
+def test_profiler_trace(tmp_path, rng):
+    from neuronx_distributed_inference_tpu.utils.profiling import \
+        profile_generate
+    app = _app()
+    ids = rng.integers(1, 500, size=(2, 8)).astype(np.int32)
+    out = profile_generate(app, ids, log_dir=str(tmp_path / "prof"),
+                           max_new_tokens=3)
+    assert out["generated"].shape == (2, 3)
+    # a trace dir with an xplane file appears
+    found = []
+    for root, _, files in os.walk(tmp_path / "prof"):
+        found += [f for f in files if f.endswith(".xplane.pb")]
+    assert found, "no xplane trace written"
