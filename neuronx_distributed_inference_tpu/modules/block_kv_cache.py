@@ -175,7 +175,8 @@ class BlockAllocator:
         raise RuntimeError("out of KV cache blocks")
 
     def allocate(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
-        """Allocate blocks for a prompt. Returns (block_ids, cached_tokens)."""
+        """Allocate blocks for a prompt. Returns (block_ids, cached_tokens).
+        On OOM this call's partial allocations are rolled back."""
         n_blocks = max(1, -(-len(token_ids) // self.block_size))
         blocks: List[int] = []
         cached_tokens = 0
@@ -196,7 +197,11 @@ class BlockAllocator:
                 parent = h
                 continue
             matching = False                # prefix broken; rest are fresh
-            blk = self._pop_block()
+            try:
+                blk = self._pop_block()
+            except RuntimeError:
+                self.free(blocks)           # roll back this call
+                raise
             m = self.meta[blk]
             m.ref_count += 1
             if self.enable_prefix_caching and full:
@@ -208,12 +213,19 @@ class BlockAllocator:
         return blocks, cached_tokens
 
     def extend(self, blocks: List[int], new_len: int) -> List[int]:
-        """Grow a running sequence's block list to cover ``new_len`` tokens."""
+        """Grow a running sequence's block list to cover ``new_len`` tokens.
+        On OOM the blocks added by this call are rolled back."""
         need = max(1, -(-new_len // self.block_size))
-        while len(blocks) < need:
-            blk = self._pop_block()
+        added: List[int] = []
+        while len(blocks) + len(added) < need:
+            try:
+                blk = self._pop_block()
+            except RuntimeError:
+                self.free(added)
+                raise
             self.meta[blk].ref_count += 1
-            blocks.append(blk)
+            added.append(blk)
+        blocks.extend(added)
         return blocks
 
     def free(self, blocks: Sequence[int]):
@@ -229,6 +241,78 @@ class BlockAllocator:
                     self.free_list.append(blk)
 
 
+class NativeBlockAllocator:
+    """ctypes wrapper over the C++ allocator (native/block_allocator.cpp) —
+    same interface and identical block-id sequences as :class:`BlockAllocator`
+    (asserted by tests). Used automatically when the native library builds."""
+
+    MAX_BLOCKS = 65536
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 enable_prefix_caching: bool = True):
+        from .. import native
+        import ctypes
+        self._ct = ctypes
+        self._lib = native.load_library()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.enable_prefix_caching = enable_prefix_caching
+        self._h = self._lib.nxdi_alloc_create(num_blocks, block_size,
+                                              int(enable_prefix_caching))
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.nxdi_alloc_destroy(h)
+            self._h = None
+
+    @property
+    def num_free(self) -> int:
+        return self._lib.nxdi_alloc_num_free(self._h)
+
+    def allocate(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
+        ct = self._ct
+        toks = np.ascontiguousarray(np.asarray(token_ids, np.int64))
+        max_out = max(1, -(-len(toks) // self.block_size))
+        out = (ct.c_int * max_out)()
+        cached = ct.c_int(0)
+        n = self._lib.nxdi_alloc_allocate(
+            self._h, toks.ctypes.data_as(ct.POINTER(ct.c_int64)), len(toks),
+            out, max_out, ct.byref(cached))
+        if n < 0:
+            raise RuntimeError("out of KV cache blocks")
+        return list(out[:n]), int(cached.value)
+
+    def extend(self, blocks: List[int], new_len: int) -> List[int]:
+        ct = self._ct
+        need = max(1, -(-new_len // self.block_size))
+        buf = (ct.c_int * max(need, len(blocks)))(*blocks)
+        n = self._lib.nxdi_alloc_extend(self._h, buf, len(blocks), new_len,
+                                        max(need, len(blocks)))
+        if n < 0:
+            raise RuntimeError("out of KV cache blocks")
+        return list(buf[:n])
+
+    def free(self, blocks: Sequence[int]):
+        ct = self._ct
+        arr = (ct.c_int * len(blocks))(*blocks)
+        if self._lib.nxdi_alloc_free(self._h, arr, len(blocks)) < 0:
+            raise RuntimeError("double free of a KV block")
+
+
+def make_block_allocator(num_blocks: int, block_size: int,
+                         enable_prefix_caching: bool = True):
+    """Prefer the native C++ allocator; fall back to the Python one
+    (NXDI_TPU_NATIVE=0 forces the fallback)."""
+    from .. import native
+    if native.native_enabled() and native.load_library() is not None:
+        return NativeBlockAllocator(num_blocks, block_size,
+                                    enable_prefix_caching)
+    return BlockAllocator(num_blocks, block_size, enable_prefix_caching)
+
+
 class BlockKVCacheManager:
     """Host-side owner: spec + cache pytree + allocator + per-seq block tables
     (reference: BlockKVCacheManager + the vLLM-facing surface)."""
@@ -238,8 +322,8 @@ class BlockKVCacheManager:
         self.spec = spec
         self.mesh = mesh
         self.cache = init_block_cache(spec, mesh)
-        self.allocator = BlockAllocator(spec.num_blocks, spec.block_size,
-                                        enable_prefix_caching)
+        self.allocator = make_block_allocator(spec.num_blocks, spec.block_size,
+                                              enable_prefix_caching)
         self.tables: Dict[int, List[int]] = {}     # seq_id -> block list
         self.lens: Dict[int, int] = {}
 

@@ -1,0 +1,88 @@
+"""Native (C++) host-runtime components, loaded via ctypes
+(native-equiv of the reference's external C++ runtime pieces — SURVEY §2.10;
+pybind11 is unavailable in this image, so the C ABI + ctypes is the binding).
+
+The shared library is compiled on first use with the system toolchain and
+cached next to the sources; set NXDI_TPU_NATIVE=0 to force the pure-Python
+fallbacks."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("nxdi_tpu")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "_build", "libnxdi_native.so")
+_SOURCES = ["block_allocator.cpp"]
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def native_enabled() -> bool:
+    return os.environ.get("NXDI_TPU_NATIVE", "1") not in ("0", "false")
+
+
+def _compile() -> bool:
+    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if (os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) >= newest_src):
+        return True
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           *srcs, "-o", _LIB_PATH]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        logger.info("native: built %s", _LIB_PATH)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired) as e:
+        err = getattr(e, "stderr", b"")
+        logger.warning("native build failed (%s); using Python fallbacks: %s",
+                       e, err.decode() if isinstance(err, bytes) else err)
+        return False
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """Compile (if stale) and dlopen the native library; None on failure or
+    when disabled — callers fall back to Python implementations."""
+    global _lib, _load_failed
+    if not native_enabled() or _load_failed:
+        return None
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _compile():
+            _load_failed = True
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.nxdi_alloc_create.restype = ctypes.c_void_p
+        lib.nxdi_alloc_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.nxdi_alloc_destroy.argtypes = [ctypes.c_void_p]
+        lib.nxdi_alloc_allocate.restype = ctypes.c_int
+        lib.nxdi_alloc_allocate.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.nxdi_alloc_extend.restype = ctypes.c_int
+        lib.nxdi_alloc_extend.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int]
+        lib.nxdi_alloc_free.restype = ctypes.c_int
+        lib.nxdi_alloc_free.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_int),
+                                        ctypes.c_int]
+        lib.nxdi_alloc_num_free.restype = ctypes.c_int
+        lib.nxdi_alloc_num_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib

@@ -1,0 +1,216 @@
+// Native host-runtime component: paged-KV block allocator with content-hash
+// prefix caching + LRU eviction (native-equiv of the reference's external
+// runtime/allocator components, SURVEY §2.10; mirrors the semantics of
+// modules/block_kv_cache.py BlockAllocator exactly — the Python unit tests
+// assert identical block-id sequences).
+//
+// The allocator sits on the per-step host hot path of the paged serving loop
+// (begin_sequence/grow/end_sequence per request per token), which is why it
+// is native: no Python dict/list overhead, O(1) ops via intrusive free list +
+// LRU, 64-bit FNV-1a chained block hashing.
+//
+// C ABI (ctypes-friendly), no exceptions across the boundary.
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 1469598103934665603ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline uint64_t fnv1a(uint64_t h, const uint8_t* data, size_t n) {
+  for (size_t i = 0; i < n; ++i) {
+    h ^= data[i];
+    h *= kFnvPrime;
+  }
+  return h;
+}
+
+inline uint64_t hash_block(uint64_t parent, const int64_t* tokens, int n) {
+  uint64_t h = kFnvOffset;
+  h = fnv1a(h, reinterpret_cast<const uint8_t*>(&parent), sizeof(parent));
+  h = fnv1a(h, reinterpret_cast<const uint8_t*>(tokens),
+            static_cast<size_t>(n) * sizeof(int64_t));
+  // avoid the reserved "no hash" sentinel
+  return h == 0 ? 1 : h;
+}
+
+struct BlockMeta {
+  int32_t ref_count = 0;
+  uint64_t content_hash = 0;  // 0 = none (mutable / tail block)
+};
+
+class Allocator {
+ public:
+  Allocator(int num_blocks, int block_size, bool prefix_caching)
+      : block_size_(block_size),
+        prefix_(prefix_caching),
+        num_blocks_(num_blocks),
+        meta_(num_blocks) {
+    free_list_.reserve(num_blocks);
+    for (int i = 1; i < num_blocks; ++i) free_list_.push_back(i);
+  }
+
+  int num_free() const {
+    return static_cast<int>(free_list_.size() + lru_.size());
+  }
+
+  // returns number of blocks written to out_blocks, or -1 on OOM/overflow
+  int allocate(const int64_t* tokens, int n_tokens, int* out_blocks,
+               int max_out, int* out_cached_tokens) {
+    int n_blocks = n_tokens <= 0 ? 1 : (n_tokens + block_size_ - 1) / block_size_;
+    if (n_blocks > max_out) return -1;
+    int cached = 0;
+    uint64_t parent = 0;
+    bool matching = prefix_;
+    for (int bi = 0; bi < n_blocks; ++bi) {
+      const int64_t* chunk = tokens + static_cast<int64_t>(bi) * block_size_;
+      int chunk_len = n_tokens - bi * block_size_;
+      if (chunk_len > block_size_) chunk_len = block_size_;
+      bool full = chunk_len == block_size_;
+      if (matching && full) {
+        uint64_t h = hash_block(parent, chunk, chunk_len);
+        auto it = hash_to_block_.find(h);
+        if (it != hash_to_block_.end()) {
+          int blk = it->second;
+          BlockMeta& m = meta_[blk];
+          if (m.ref_count == 0) {
+            auto li = lru_pos_.find(blk);
+            if (li != lru_pos_.end()) {
+              lru_.erase(li->second);
+              lru_pos_.erase(li);
+            }
+          }
+          m.ref_count += 1;
+          out_blocks[bi] = blk;
+          cached += block_size_;
+          parent = h;
+          continue;
+        }
+      }
+      matching = false;
+      int blk = pop_block();
+      if (blk < 0) {
+        // roll back this call's allocations
+        for (int j = 0; j < bi; ++j) release_one(out_blocks[j]);
+        return -1;
+      }
+      BlockMeta& m = meta_[blk];
+      m.ref_count += 1;
+      if (prefix_ && full) {
+        uint64_t h = hash_block(parent, chunk, chunk_len);
+        m.content_hash = h;
+        hash_to_block_[h] = blk;
+        parent = h;
+      }
+      out_blocks[bi] = blk;
+    }
+    *out_cached_tokens = cached;
+    return n_blocks;
+  }
+
+  // grow blocks to cover new_len tokens; returns new count or -1
+  // (rolling back this call's additions on OOM)
+  int extend(int* blocks, int n_blocks, int new_len, int max_out) {
+    int need = new_len <= 0 ? 1 : (new_len + block_size_ - 1) / block_size_;
+    if (need > max_out) return -1;
+    int start = n_blocks;
+    while (n_blocks < need) {
+      int blk = pop_block();
+      if (blk < 0) {
+        for (int j = start; j < n_blocks; ++j) release_one(blocks[j]);
+        return -1;
+      }
+      meta_[blk].ref_count += 1;
+      blocks[n_blocks++] = blk;
+    }
+    return n_blocks;
+  }
+
+  // returns 0 ok, -1 double free
+  int free_blocks(const int* blocks, int n) {
+    for (int i = 0; i < n; ++i) {
+      if (release_one(blocks[i]) < 0) return -1;
+    }
+    return 0;
+  }
+
+ private:
+  int release_one(int blk) {
+    BlockMeta& m = meta_[blk];
+    m.ref_count -= 1;
+    if (m.ref_count < 0) return -1;
+    if (m.ref_count == 0) {
+      if (m.content_hash != 0) {
+        lru_.push_back(blk);  // stays resident for prefix reuse
+        lru_pos_[blk] = std::prev(lru_.end());
+      } else {
+        free_list_.push_back(blk);
+      }
+    }
+    return 0;
+  }
+
+  int pop_block() {
+    if (!free_list_.empty()) {
+      int blk = free_list_.back();
+      free_list_.pop_back();
+      return blk;
+    }
+    if (!lru_.empty()) {  // evict oldest unreferenced cached block
+      int blk = lru_.front();
+      lru_.pop_front();
+      lru_pos_.erase(blk);
+      uint64_t h = meta_[blk].content_hash;
+      if (h != 0) hash_to_block_.erase(h);
+      meta_[blk] = BlockMeta{};
+      return blk;
+    }
+    return -1;
+  }
+
+  int block_size_;
+  bool prefix_;
+  int num_blocks_;
+  std::vector<BlockMeta> meta_;
+  std::vector<int> free_list_;
+  std::list<int> lru_;
+  std::unordered_map<int, std::list<int>::iterator> lru_pos_;
+  std::unordered_map<uint64_t, int> hash_to_block_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* nxdi_alloc_create(int num_blocks, int block_size, int prefix_caching) {
+  return new Allocator(num_blocks, block_size, prefix_caching != 0);
+}
+
+void nxdi_alloc_destroy(void* a) { delete static_cast<Allocator*>(a); }
+
+int nxdi_alloc_allocate(void* a, const int64_t* tokens, int n_tokens,
+                        int* out_blocks, int max_out, int* out_cached) {
+  return static_cast<Allocator*>(a)->allocate(tokens, n_tokens, out_blocks,
+                                              max_out, out_cached);
+}
+
+int nxdi_alloc_extend(void* a, int* blocks, int n_blocks, int new_len,
+                      int max_out) {
+  return static_cast<Allocator*>(a)->extend(blocks, n_blocks, new_len,
+                                            max_out);
+}
+
+int nxdi_alloc_free(void* a, const int* blocks, int n) {
+  return static_cast<Allocator*>(a)->free_blocks(blocks, n);
+}
+
+int nxdi_alloc_num_free(void* a) {
+  return static_cast<Allocator*>(a)->num_free();
+}
+
+}  // extern "C"
