@@ -1,0 +1,44 @@
+"""Generic encoder application base (reference: models/encoder_base.py
+``NeuronEncoderBase`` / ``NeuronEncoderApplication`` :16,24 — a non-LM app
+holding a list of jitted submodels sharing one weight set).
+
+An encoder submodel here is (name, pure function, donate spec); the app jits
+each on first use and routes calls by name — the compile/load lifecycle
+mirrors CausalLMApplication without the generation loop."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+class EncoderApplication:
+    """Holds params + named jitted forwards (vision towers, audio encoders,
+    T5-style encoders...)."""
+
+    def __init__(self, params: Any, submodels: Dict[str, Callable],
+                 mesh=None):
+        self.params = params
+        self.mesh = mesh
+        self._fns = dict(submodels)
+        self._compiled: Dict[str, Any] = {}
+
+    def add_submodel(self, name: str, fn: Callable):
+        self._fns[name] = fn
+        self._compiled.pop(name, None)
+
+    def get_compiled(self, name: str):
+        if name not in self._compiled:
+            self._compiled[name] = jax.jit(self._fns[name])
+        return self._compiled[name]
+
+    def run(self, name: str, *args, **kwargs):
+        return self.get_compiled(name)(self.params, *args, **kwargs)
+
+    def warmup(self, example_inputs: Dict[str, Tuple]):
+        for name, args in example_inputs.items():
+            jax.block_until_ready(self.run(name, *args))
+        return self

@@ -1,0 +1,2 @@
+from .modeling_whisper import (TpuWhisperForConditionalGeneration,
+                               WhisperApplication, WhisperInferenceConfig)

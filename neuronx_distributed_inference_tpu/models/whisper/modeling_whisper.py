@@ -1,0 +1,336 @@
+"""Whisper encoder-decoder (reference: models/whisper/modeling_whisper.py
+:571-678 — encoder + decoder applications with cross-attention KV cache and
+separate prefill/decode wrappers; 951 LoC).
+
+TPU design: three jitted pure functions sharing one param tree —
+  * ``encoder_forward``  — conv frontend + sinusoidal positions + bidirectional
+    self-attention stack (lax.scan)
+  * ``compute_cross_kv`` — the per-request cross-attention K/V, computed ONCE
+    from the encoder output (the reference caches these in its own
+    multimodal KV manager, modules/kvcache/multimodal_kv_cache_manager.py)
+  * ``decoder_step``     — causal self-attn over a donated KV cache + static
+    cross-attn + mlp; serves both the forced-decoder prefill and the
+    autoregressive loop (T>=1)
+
+All LayerNorms carry biases; q/v/out projections have biases, k does not
+(matching WhisperAttention). Weights are replicated (whisper-large is ~1.5B;
+TP hooks can reuse the decoder ParamSpec machinery later)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig, TpuConfig
+from ...ops.normalization import layer_norm
+
+
+@dataclass(frozen=True)
+class WhisperSpec:
+    d_model: int
+    encoder_layers: int
+    decoder_layers: int
+    encoder_heads: int
+    decoder_heads: int
+    ffn_dim: int
+    vocab_size: int
+    num_mel_bins: int
+    max_source_positions: int     # encoder positions (1500)
+    max_target_positions: int     # decoder positions (448)
+    decoder_start_token_id: int
+    eos_token_id: int
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.decoder_heads
+
+
+def spec_from_hf_config(cfg) -> WhisperSpec:
+    return WhisperSpec(
+        d_model=cfg.d_model,
+        encoder_layers=cfg.encoder_layers,
+        decoder_layers=cfg.decoder_layers,
+        encoder_heads=cfg.encoder_attention_heads,
+        decoder_heads=cfg.decoder_attention_heads,
+        ffn_dim=getattr(cfg, "encoder_ffn_dim", 4 * cfg.d_model),
+        vocab_size=cfg.vocab_size,
+        num_mel_bins=cfg.num_mel_bins,
+        max_source_positions=cfg.max_source_positions,
+        max_target_positions=cfg.max_target_positions,
+        decoder_start_token_id=cfg.decoder_start_token_id,
+        eos_token_id=cfg.eos_token_id,
+    )
+
+
+def _mha(q, k, v, heads: int, mask=None):
+    """(B,T,H)x(B,S,H) attention, fp32 softmax; q pre-scaled."""
+    b, t, hd = q.shape
+    s = k.shape[1]
+    d = hd // heads
+    qf = q.reshape(b, t, heads, d).astype(jnp.float32)
+    kf = k.reshape(b, s, heads, d).astype(jnp.float32)
+    vf = v.reshape(b, s, heads, d).astype(jnp.float32)
+    scores = jnp.einsum("bthd,bshd->bhts", qf, kf)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, :, :], scores, -30000.0)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, vf)
+    return out.reshape(b, t, hd).astype(q.dtype)
+
+
+def _attn_proj(lw, prefix, x):
+    """q/k/v projections with whisper's bias layout (k has none)."""
+    q = x @ lw[f"{prefix}_q_w"] + lw[f"{prefix}_q_b"]
+    k = x @ lw[f"{prefix}_k_w"]
+    v = x @ lw[f"{prefix}_v_w"] + lw[f"{prefix}_v_b"]
+    return q, k, v
+
+
+def sinusoidal_positions(length: int, dim: int) -> np.ndarray:
+    """Whisper's fixed encoder position table."""
+    log_timescale = np.log(10000.0) / (dim // 2 - 1)
+    inv = np.exp(-log_timescale * np.arange(dim // 2, dtype=np.float32))
+    t = np.arange(length, dtype=np.float32)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1).astype(np.float32)
+
+
+def encoder_forward(spec: WhisperSpec, params, input_features):
+    """mel features (B, n_mels, T) -> encoder states (B, T//2, H)."""
+    enc = params["encoder"]
+    dn = ("NCH", "OIH", "NCH")
+    x = jax.lax.conv_general_dilated(
+        input_features, enc["conv1_w"], (1,), [(1, 1)], dimension_numbers=dn)
+    x = jax.nn.gelu(x + enc["conv1_b"][None, :, None], approximate=False)
+    x = jax.lax.conv_general_dilated(
+        x, enc["conv2_w"], (2,), [(1, 1)], dimension_numbers=dn)
+    x = jax.nn.gelu(x + enc["conv2_b"][None, :, None], approximate=False)
+    x = jnp.transpose(x, (0, 2, 1))                    # (B, S, H)
+    x = x + enc["pos"][: x.shape[1]]
+
+    scale = spec.head_dim ** -0.5
+
+    def body(h, lw):
+        r = layer_norm(h, lw["ln1_w"], lw["ln1_b"])
+        q, k, v = _attn_proj(lw, "self", r)
+        a = _mha(q * scale, k, v, spec.encoder_heads)
+        h = h + (a @ lw["self_o_w"] + lw["self_o_b"])
+        r = layer_norm(h, lw["ln2_w"], lw["ln2_b"])
+        m = jax.nn.gelu(r @ lw["fc1_w"] + lw["fc1_b"], approximate=False)
+        h = h + (m @ lw["fc2_w"] + lw["fc2_b"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, enc["layers"])
+    return layer_norm(x, enc["ln_f_w"], enc["ln_f_b"])
+
+
+def compute_cross_kv(spec: WhisperSpec, params, enc_out):
+    """Per-request cross-attention K/V: (L, B, S_enc, H) each."""
+    dec = params["decoder"]
+
+    def body(_, lw):
+        k = enc_out @ lw["cross_k_w"]
+        v = enc_out @ lw["cross_v_w"] + lw["cross_v_b"]
+        return None, (k, v)
+
+    _, (ks, vs) = jax.lax.scan(body, None, dec["layers"])
+    return {"k": ks, "v": vs}
+
+
+def decoder_step(spec: WhisperSpec, params, cache, cross_kv, tokens,
+                 positions):
+    """tokens (B, T) at absolute ``positions`` (B, T); self-KV cache
+    {'k','v'} (L, B, S_max, H) donated. Returns logits (B, T, V) + cache."""
+    dec = params["decoder"]
+    b, t = tokens.shape
+    x = dec["embed"][tokens] + dec["pos"][positions]
+    s_max = cache["k"].shape[2]
+    kv_pos = jnp.arange(s_max, dtype=positions.dtype)
+    causal = kv_pos[None, None, :] <= positions[:, :, None]   # (B, T, S)
+    scale = spec.head_dim ** -0.5
+    bidx = jnp.arange(b)
+
+    def body(h, xs):
+        lw, kc, vc, ck, cv = xs
+        r = layer_norm(h, lw["ln1_w"], lw["ln1_b"])
+        q, k, v = _attn_proj(lw, "self", r)
+        kc = kc.at[bidx[:, None], positions].set(k, mode="drop")
+        vc = vc.at[bidx[:, None], positions].set(v, mode="drop")
+        a = _mha(q * scale, kc, vc, spec.decoder_heads, mask=causal)
+        h = h + (a @ lw["self_o_w"] + lw["self_o_b"])
+        r = layer_norm(h, lw["ln2_w"], lw["ln2_b"])
+        q = (r @ lw["cross_q_w"] + lw["cross_q_b"]) * scale
+        a = _mha(q, ck, cv, spec.decoder_heads)
+        h = h + (a @ lw["cross_o_w"] + lw["cross_o_b"])
+        r = layer_norm(h, lw["ln3_w"], lw["ln3_b"])
+        m = jax.nn.gelu(r @ lw["fc1_w"] + lw["fc1_b"], approximate=False)
+        h = h + (m @ lw["fc2_w"] + lw["fc2_b"])
+        return h, (kc, vc)
+
+    x, (nk, nv) = jax.lax.scan(
+        body, x, (dec["layers"], cache["k"], cache["v"],
+                  cross_kv["k"], cross_kv["v"]))
+    x = layer_norm(x, dec["ln_f_w"], dec["ln_f_b"])
+    logits = (x @ dec["embed"].T).astype(jnp.float32)   # tied proj_out
+    return {"logits": logits, "cache": {"k": nk, "v": nv}}
+
+
+# ---------------------------------------------------------------------------
+# checkpoint conversion (HF WhisperForConditionalGeneration)
+# ---------------------------------------------------------------------------
+
+def convert_hf_state_dict(sd: Dict[str, np.ndarray], spec: WhisperSpec
+                          ) -> Dict[str, Any]:
+    def get(n):
+        if n in sd:
+            return np.asarray(sd[n], np.float32)
+        raise KeyError(f"missing checkpoint tensor {n}")
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def attn(base, prefix, cross=False):
+        out = {
+            f"{prefix}_q_w": t(get(f"{base}.q_proj.weight")),
+            f"{prefix}_q_b": get(f"{base}.q_proj.bias"),
+            f"{prefix}_k_w": t(get(f"{base}.k_proj.weight")),
+            f"{prefix}_v_w": t(get(f"{base}.v_proj.weight")),
+            f"{prefix}_v_b": get(f"{base}.v_proj.bias"),
+            f"{prefix}_o_w": t(get(f"{base}.out_proj.weight")),
+            f"{prefix}_o_b": get(f"{base}.out_proj.bias"),
+        }
+        return out
+
+    def enc_layer(i):
+        b = f"model.encoder.layers.{i}"
+        lw = attn(f"{b}.self_attn", "self")
+        lw.update({
+            "ln1_w": get(f"{b}.self_attn_layer_norm.weight"),
+            "ln1_b": get(f"{b}.self_attn_layer_norm.bias"),
+            "ln2_w": get(f"{b}.final_layer_norm.weight"),
+            "ln2_b": get(f"{b}.final_layer_norm.bias"),
+            "fc1_w": t(get(f"{b}.fc1.weight")), "fc1_b": get(f"{b}.fc1.bias"),
+            "fc2_w": t(get(f"{b}.fc2.weight")), "fc2_b": get(f"{b}.fc2.bias"),
+        })
+        return lw
+
+    def dec_layer(i):
+        b = f"model.decoder.layers.{i}"
+        lw = attn(f"{b}.self_attn", "self")
+        lw.update(attn(f"{b}.encoder_attn", "cross"))
+        lw.update({
+            "ln1_w": get(f"{b}.self_attn_layer_norm.weight"),
+            "ln1_b": get(f"{b}.self_attn_layer_norm.bias"),
+            "ln2_w": get(f"{b}.encoder_attn_layer_norm.weight"),
+            "ln2_b": get(f"{b}.encoder_attn_layer_norm.bias"),
+            "ln3_w": get(f"{b}.final_layer_norm.weight"),
+            "ln3_b": get(f"{b}.final_layer_norm.bias"),
+            "fc1_w": t(get(f"{b}.fc1.weight")), "fc1_b": get(f"{b}.fc1.bias"),
+            "fc2_w": t(get(f"{b}.fc2.weight")), "fc2_b": get(f"{b}.fc2.bias"),
+        })
+        return lw
+
+    def stack(ls):
+        return {k: np.stack([d[k] for d in ls]) for k in ls[0]}
+
+    return {
+        "encoder": {
+            "conv1_w": get("model.encoder.conv1.weight"),
+            "conv1_b": get("model.encoder.conv1.bias"),
+            "conv2_w": get("model.encoder.conv2.weight"),
+            "conv2_b": get("model.encoder.conv2.bias"),
+            "pos": get("model.encoder.embed_positions.weight"),
+            "layers": stack([enc_layer(i) for i in range(spec.encoder_layers)]),
+            "ln_f_w": get("model.encoder.layer_norm.weight"),
+            "ln_f_b": get("model.encoder.layer_norm.bias"),
+        },
+        "decoder": {
+            "embed": get("model.decoder.embed_tokens.weight"),
+            "pos": get("model.decoder.embed_positions.weight"),
+            "layers": stack([dec_layer(i) for i in range(spec.decoder_layers)]),
+            "ln_f_w": get("model.decoder.layer_norm.weight"),
+            "ln_f_b": get("model.decoder.layer_norm.bias"),
+        },
+    }
+
+
+class WhisperApplication:
+    """Encode-once + autoregressive decode (reference: the whisper encoder/
+    decoder NeuronApplications with their own prefill/decode ModelWrappers).
+    """
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.spec = spec_from_hf_config(config)
+        self.model_path = model_path
+        self.params = None
+        self._encode = jax.jit(partial(encoder_forward, self.spec))
+        self._cross = jax.jit(partial(compute_cross_kv, self.spec))
+        self._step = jax.jit(partial(decoder_step, self.spec),
+                             donate_argnums=(1,))
+
+    def load_weights(self, model_path: Optional[str] = None):
+        from ...utils import checkpoint as ckpt
+        sd = ckpt.load_state_dict(model_path or self.model_path)
+        host = convert_hf_state_dict(sd, self.spec)
+        self.params = jax.tree.map(jnp.asarray, host)
+        return self
+
+    def init_cache(self, batch: int):
+        s = self.spec
+        smax = min(self.tpu_config.seq_len, s.max_target_positions)
+        return {"k": jnp.zeros((s.decoder_layers, batch, smax, s.d_model)),
+                "v": jnp.zeros((s.decoder_layers, batch, smax, s.d_model))}
+
+    def generate(self, input_features: np.ndarray, max_new_tokens: int = 32,
+                 decoder_input_ids: Optional[np.ndarray] = None
+                 ) -> Dict[str, Any]:
+        """Greedy transcription. input_features (B, n_mels, T)."""
+        b = input_features.shape[0]
+        enc = self._encode(self.params, jnp.asarray(input_features))
+        cross = self._cross(self.params, enc)
+        cache = self.init_cache(b)
+        if decoder_input_ids is None:
+            decoder_input_ids = np.full((b, 1), self.spec.decoder_start_token_id,
+                                        np.int32)
+        toks = np.asarray(decoder_input_ids, np.int32)
+        t0 = toks.shape[1]
+        pos = np.broadcast_to(np.arange(t0, dtype=np.int32), (b, t0))
+        out = self._step(self.params, cache, cross, jnp.asarray(toks),
+                         jnp.asarray(pos))
+        cache = out["cache"]
+        cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),
+                         np.int32)
+        generated = [cur[:, None]]
+        done = cur == self.spec.eos_token_id
+        for i in range(1, max_new_tokens):
+            p = np.full((b, 1), t0 + i - 1, np.int32)
+            out = self._step(self.params, cache, cross,
+                             jnp.asarray(generated[-1][:, -1:]), jnp.asarray(p))
+            cache = out["cache"]
+            cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),
+                             np.int32)
+            generated.append(cur[:, None])
+            done |= cur == self.spec.eos_token_id
+            if done.all():
+                break
+        gen = np.concatenate(generated, axis=1)
+        return {"sequences": np.concatenate([toks, gen], axis=1),
+                "generated": gen, "encoder_states": np.asarray(enc)}
+
+
+class WhisperInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["d_model", "encoder_layers", "decoder_layers", "vocab_size",
+                "num_mel_bins", "max_source_positions",
+                "max_target_positions"]
+
+
+def TpuWhisperForConditionalGeneration(model_path: str,
+                                       config: InferenceConfig):
+    return WhisperApplication(model_path, config)
