@@ -244,7 +244,8 @@ class CausalLMApplication:
 
     def _run_prefill(self, input_ids: np.ndarray, seq_lens: np.ndarray,
                      seq_ids: Optional[np.ndarray] = None,
-                     sampling_params=None, adapter_ids=None):
+                     sampling_params=None, adapter_ids=None,
+                     image_embeds=None, image_mask=None):
         b, s = input_ids.shape
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -258,10 +259,12 @@ class CausalLMApplication:
                                      "seq_ids": seq_ids,
                                      "seq_lens": seq_lens},
                                     weights=self.params)
+        if image_mask is not None:
+            image_mask = jnp.asarray(np.asarray(image_mask, bool))
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
                  jnp.asarray(seq_lens), sampling_params, self._next_rng(),
-                 adapter_ids, self.replacements)
+                 adapter_ids, self.replacements, image_embeds, image_mask)
         self.cache = out["cache"]
         return out
 
@@ -319,7 +322,9 @@ class CausalLMApplication:
                  sampling_params: Optional[np.ndarray] = None,
                  return_logits: bool = False,
                  teacher_tokens: Optional[np.ndarray] = None,
-                 adapter_ids: Optional[np.ndarray] = None) -> Dict[str, Any]:
+                 adapter_ids: Optional[np.ndarray] = None,
+                 image_embeds=None,
+                 image_mask: Optional[np.ndarray] = None) -> Dict[str, Any]:
         """Greedy/sampled generation. input_ids (B, S) right-padded;
         attention_mask (B, S) marks real tokens. Returns sequences including
         the prompt (HF convention).
@@ -352,6 +357,10 @@ class CausalLMApplication:
         bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
         padded = np.zeros((b, bucket), input_ids.dtype)
         padded[:, :s] = input_ids
+        padded_img_mask = None
+        if image_mask is not None:
+            padded_img_mask = np.zeros((b, bucket), bool)
+            padded_img_mask[:, :s] = np.asarray(image_mask, bool)
         max_total = int(seq_lens.max()) + max_new_tokens
         if max_total > self.tpu_config.seq_len:
             max_new_tokens = self.tpu_config.seq_len - int(seq_lens.max())
@@ -360,7 +369,9 @@ class CausalLMApplication:
 
         t0 = time.perf_counter()
         out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params,
-                                adapter_ids=adapter_ids)
+                                adapter_ids=adapter_ids,
+                                image_embeds=image_embeds,
+                                image_mask=padded_img_mask)
         tokens = np.asarray(out["tokens"]).reshape(b, 1)
         logits_trace = [np.asarray(out["logits"])] if return_logits and "logits" in out else []
         ttft = time.perf_counter() - t0

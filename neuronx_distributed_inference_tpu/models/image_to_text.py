@@ -1,0 +1,160 @@
+"""Image-to-text application base (reference:
+models/image_to_text_model_base.py ``ImageToTextInferenceConfig`` /
+``NeuronBaseForImageToText`` :34,118 — two builders (text+vision), separate
+compile/load, vision+text forward; 773+309 LoC).
+
+TPU design: a vision tower (models/vision.py ViT), a multimodal projector,
+and the standard text CausalLMApplication. The projected image features are
+merged into the prefill embeddings at the image-token positions inside the
+text graph (model_base.context_encoding_step image_embeds/image_mask);
+decode is the plain text decode. Concrete family here: LLaVA-style
+(CLIP tower + 2-layer gelu projector + llama text) — the shape shared by
+pixtral / llama4's llava-like composition (SURVEY §2.7)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig, TpuConfig
+from ..utils import checkpoint as ckpt
+from . import model_base, vision
+from .application import CausalLMApplication
+from .encoder_base import EncoderApplication
+from .family import get_family
+
+
+class ImageToTextInferenceConfig(InferenceConfig):
+    """Holds text_config + vision_config dicts (reference:
+    ImageToTextInferenceConfig)."""
+
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "image_token_index"]
+
+    def get_text_config(self) -> InferenceConfig:
+        tc = dict(self.text_config)
+        family = get_family(tc.get("model_type", "llama"))
+        return family.config_cls(self.tpu_config, **tc)
+
+
+class ImageToTextApplication:
+    """Vision tower + projector + text LM (reference:
+    NeuronBaseForImageToText)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: ImageToTextInferenceConfig, mesh=None):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        text_cfg = config.get_text_config()
+        self.text = CausalLMApplication(model_path, text_cfg, mesh=mesh)
+        feature_layer = int(getattr(config, "vision_feature_layer", -2))
+        self.vit_spec = vision.vit_spec_from_hf(dict(config.vision_config),
+                                                feature_layer=feature_layer)
+        self.select_strategy = getattr(config, "vision_feature_select_strategy",
+                                       "default")
+        self.image_token_index = int(config.image_token_index)
+        self.vision_params = None
+        self.projector = None
+        self._vit = jax.jit(partial(vision.vit_forward, self.vit_spec))
+        self._project = jax.jit(self._project_fn)
+
+    # -- weights --
+    def load_weights(self, model_path: Optional[str] = None):
+        path = model_path or self.model_path
+        sd = ckpt.load_state_dict(path)
+        # text weights may sit under model.language_model. / language_model.
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+                continue
+            for pre, new in (("model.language_model.", "model."),
+                             ("language_model.model.", "model."),
+                             ("language_model.", "model.")):
+                if k.startswith(pre):
+                    text_sd[new + k[len(pre):]] = v
+                    break
+        self.text.params = None
+        host = self.text.family.convert_hf_state_dict(text_sd, self.text.spec)
+        self.text._put_params(host)
+
+        vis_prefix = ("model.vision_tower" if any(
+            k.startswith("model.vision_tower") for k in sd) else "vision_tower")
+        self.vision_params = jax.tree.map(jnp.asarray,
+                                          vision.convert_clip_vision_tower(
+                                              sd, self.vit_spec, vis_prefix))
+        proj_prefix = ("model.multi_modal_projector" if any(
+            k.startswith("model.multi_modal_projector") for k in sd)
+            else "multi_modal_projector")
+
+        def t(w):
+            return jnp.asarray(np.ascontiguousarray(
+                np.asarray(w, np.float32).T))
+
+        self.projector = {
+            "w1": t(sd[f"{proj_prefix}.linear_1.weight"]),
+            "b1": jnp.asarray(np.asarray(
+                sd[f"{proj_prefix}.linear_1.bias"], np.float32)),
+            "w2": t(sd[f"{proj_prefix}.linear_2.weight"]),
+            "b2": jnp.asarray(np.asarray(
+                sd[f"{proj_prefix}.linear_2.bias"], np.float32)),
+        }
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def _project_fn(self, projector, feats):
+        h = feats @ projector["w1"] + projector["b1"]
+        h = jax.nn.gelu(h, approximate=False)
+        return h @ projector["w2"] + projector["b2"]
+
+    def encode_images(self, pixel_values: np.ndarray) -> jnp.ndarray:
+        """pixel_values (N_images, C, H, W) -> projected features
+        (N_images, tokens_per_image, H_text)."""
+        feats = self._vit(self.vision_params, jnp.asarray(pixel_values))
+        if self.select_strategy == "default" and self.vit_spec.use_cls_token:
+            feats = feats[:, 1:]                   # drop CLS
+        return self._project(self.projector, feats)
+
+    @property
+    def tokens_per_image(self) -> int:
+        drop = 1 if (self.select_strategy == "default"
+                     and self.vit_spec.use_cls_token) else 0
+        return self.vit_spec.num_tokens - drop
+
+    def generate(self, input_ids: np.ndarray, pixel_values: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 return_logits: bool = False) -> Dict[str, Any]:
+        """input_ids contain ``image_token_index`` placeholders (one per
+        image patch token, HF llava convention); pixel_values (B, C, H, W)
+        one image per row (multi-image: flatten rows upstream)."""
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        image_mask = (input_ids == self.image_token_index)
+        feats = self.encode_images(pixel_values)       # (B, T_img, H)
+        if self.text.cache is None:
+            self.text.init_cache()
+        # merged prefill runs through the text app with the image args bound
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            return_logits=return_logits,
+            image_embeds=feats, image_mask=image_mask)
+
+    def reset(self):
+        self.text.reset()
+        return self
+
+
+def TpuLlavaForConditionalGeneration(model_path: str,
+                                     config: ImageToTextInferenceConfig):
+    return ImageToTextApplication(model_path, config)

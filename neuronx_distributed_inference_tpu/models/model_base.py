@@ -710,10 +710,16 @@ def _lm_head(spec: DecoderSpec, params, hidden):
 def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids, seq_lens,
                           sampling_params, rng, adapter_ids=None,
-                          replacements=None):
+                          replacements=None, image_embeds=None,
+                          image_mask=None):
     """Prefill graph (reference submodel tag ``context_encoding_model``).
 
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
+    image_embeds (B, N_img, H) + image_mask (B, S): multimodal prefill —
+    projected vision features replace the embeddings at the image-token
+    positions, in order (reference: image-to-text merge,
+    models/image_to_text_model_base.py + deepstack embeds
+    model_base.py:1374-1387).
     Returns dict(tokens (B,), last_logits (B, V) [optional], cache).
     """
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.prefill_causal_mask(
@@ -721,6 +727,13 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
     hidden = _embed(spec, params, input_ids)
+    if image_embeds is not None:
+        # scatter the i-th image feature into the i-th image-token slot
+        gather_idx = jnp.clip(jnp.cumsum(image_mask, axis=1) - 1, 0,
+                              image_embeds.shape[1] - 1)
+        img = jnp.take_along_axis(image_embeds.astype(hidden.dtype),
+                                  gather_idx[..., None], axis=1)
+        hidden = jnp.where(image_mask[..., None], img, hidden)
     if spec.seq_parallel:
         # SP: shard the embedded sequence (reference: reduce-scatter of
         # embeddings, model_base.py:1482-1517)

@@ -1,0 +1,169 @@
+"""Generic ViT vision encoder (reference: the vision towers of
+models/mllama/, models/llama4/, models/pixtral/, models/qwen2_vl/ and the
+encoder side of models/image_to_text_model_base.py — SURVEY §2.7).
+
+CLIP-style: patch conv + optional CLS token + learned positions + pre-LN
+transformer stack. ``feature_layer`` selects which hidden state feeds the
+multimodal projector (llava uses -2, the penultimate layer, PRE final
+layernorm — HF hidden_states semantics)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.normalization import layer_norm
+
+VIT_ACTS = {
+    "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "silu": jax.nn.silu,
+}
+
+
+@dataclass(frozen=True)
+class VitSpec:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    image_size: int
+    num_channels: int = 3
+    use_cls_token: bool = True
+    pre_layernorm: bool = True
+    act: str = "quick_gelu"
+    eps: float = 1e-5
+    # which hidden state feeds downstream (HF hidden_states indexing:
+    # 0 = embeddings, i = after layer i; negatives from the end)
+    feature_layer: int = -1
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def num_tokens(self) -> int:
+        return self.num_patches + (1 if self.use_cls_token else 0)
+
+
+def vit_spec_from_hf(cfg, feature_layer: int = -1) -> VitSpec:
+    return VitSpec(
+        hidden_size=cfg["hidden_size"],
+        num_layers=cfg["num_hidden_layers"],
+        num_heads=cfg["num_attention_heads"],
+        intermediate_size=cfg["intermediate_size"],
+        patch_size=cfg["patch_size"],
+        image_size=cfg["image_size"],
+        num_channels=cfg.get("num_channels", 3),
+        act=cfg.get("hidden_act", "quick_gelu"),
+        eps=cfg.get("layer_norm_eps", 1e-5),
+        feature_layer=feature_layer,
+    )
+
+
+def vit_forward(spec: VitSpec, params, pixel_values) -> jnp.ndarray:
+    """pixel_values (B, C, H, W) -> features (B, tokens, hidden) at
+    ``feature_layer`` (pre final-LN, matching HF hidden_states)."""
+    dn = ("NCHW", "OIHW", "NCHW")
+    p = spec.patch_size
+    x = jax.lax.conv_general_dilated(
+        pixel_values, params["patch_embed"], (p, p), "VALID",
+        dimension_numbers=dn)                       # (B, H, gh, gw)
+    b, h, gh, gw = x.shape
+    x = x.reshape(b, h, gh * gw).transpose(0, 2, 1)  # (B, T, H)
+    if spec.use_cls_token:
+        cls = jnp.broadcast_to(params["cls"], (b, 1, h))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos"][: x.shape[1]]
+    if spec.pre_layernorm:
+        x = layer_norm(x, params["ln_pre_w"], params["ln_pre_b"], spec.eps)
+
+    act = VIT_ACTS[spec.act]
+    scale = spec.head_dim ** -0.5
+    nh = spec.num_heads
+
+    def body(hh, lw):
+        r = layer_norm(hh, lw["ln1_w"], lw["ln1_b"], spec.eps)
+        q = (r @ lw["q_w"] + lw["q_b"]) * scale
+        k = r @ lw["k_w"] + lw["k_b"]
+        v = r @ lw["v_w"] + lw["v_b"]
+        t = r.shape[1]
+        qf = q.reshape(b, t, nh, -1).astype(jnp.float32)
+        kf = k.reshape(b, t, nh, -1).astype(jnp.float32)
+        vf = v.reshape(b, t, nh, -1).astype(jnp.float32)
+        s = jnp.einsum("bthd,bshd->bhts", qf, kf)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhts,bshd->bthd", pr, vf).reshape(b, t, -1)
+        hh = hh + (a.astype(hh.dtype) @ lw["o_w"] + lw["o_b"])
+        r = layer_norm(hh, lw["ln2_w"], lw["ln2_b"], spec.eps)
+        m = act(r @ lw["fc1_w"] + lw["fc1_b"])
+        hh = hh + (m @ lw["fc2_w"] + lw["fc2_b"])
+        return hh, hh
+
+    x, states = jax.lax.scan(body, x, params["layers"])
+    # hidden_states list = [embeddings] + per-layer outputs
+    fl = spec.feature_layer % (spec.num_layers + 1)
+    if fl == 0:
+        return x * 0 + x  # embeddings themselves never used in practice
+    return states[fl - 1]
+
+
+def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
+                              prefix: str) -> Dict[str, Any]:
+    """HF CLIPVisionModel names (``<prefix>.vision_model...``) -> param tree.
+    Sub-models with no CLS / no pre-LN skip those keys."""
+
+    def get(n):
+        if n in sd:
+            return np.asarray(sd[n], np.float32)
+        raise KeyError(f"missing checkpoint tensor {n}")
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    vm = prefix + ".vision_model"
+
+    def lw(i):
+        b = f"{vm}.encoder.layers.{i}"
+        return {
+            "ln1_w": get(f"{b}.layer_norm1.weight"),
+            "ln1_b": get(f"{b}.layer_norm1.bias"),
+            "q_w": t(get(f"{b}.self_attn.q_proj.weight")),
+            "q_b": get(f"{b}.self_attn.q_proj.bias"),
+            "k_w": t(get(f"{b}.self_attn.k_proj.weight")),
+            "k_b": get(f"{b}.self_attn.k_proj.bias"),
+            "v_w": t(get(f"{b}.self_attn.v_proj.weight")),
+            "v_b": get(f"{b}.self_attn.v_proj.bias"),
+            "o_w": t(get(f"{b}.self_attn.out_proj.weight")),
+            "o_b": get(f"{b}.self_attn.out_proj.bias"),
+            "ln2_w": get(f"{b}.layer_norm2.weight"),
+            "ln2_b": get(f"{b}.layer_norm2.bias"),
+            "fc1_w": t(get(f"{b}.mlp.fc1.weight")),
+            "fc1_b": get(f"{b}.mlp.fc1.bias"),
+            "fc2_w": t(get(f"{b}.mlp.fc2.weight")),
+            "fc2_b": get(f"{b}.mlp.fc2.bias"),
+        }
+
+    layers = [lw(i) for i in range(spec.num_layers)]
+    out: Dict[str, Any] = {
+        "patch_embed": get(f"{vm}.embeddings.patch_embedding.weight"),
+        "pos": get(f"{vm}.embeddings.position_embedding.weight"),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+    }
+    if spec.use_cls_token:
+        out["cls"] = get(f"{vm}.embeddings.class_embedding")
+    if spec.pre_layernorm:
+        # HF CLIP ships this historical typo in the weight name
+        out["ln_pre_w"] = get(f"{vm}.pre_layrnorm.weight")
+        out["ln_pre_b"] = get(f"{vm}.pre_layrnorm.bias")
+    return out

@@ -1,0 +1,99 @@
+"""Image-to-text (LLaVA-style) golden tests vs HF CPU (reference:
+models/image_to_text_model_base.py + the llava-shaped families —
+pixtral/llama4 composition, SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.image_to_text import (
+    ImageToTextApplication, ImageToTextInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def tiny_llava(tmp_path_factory):
+    from transformers import (CLIPVisionConfig, LlavaConfig,
+                              LlavaForConditionalGeneration)
+    torch.manual_seed(0)
+    vc = CLIPVisionConfig(hidden_size=32, intermediate_size=64,
+                          num_hidden_layers=3, num_attention_heads=4,
+                          image_size=16, patch_size=8, num_channels=3)
+    tc = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, vocab_size=256, rms_norm_eps=1e-5,
+              max_position_embeddings=128, tie_word_embeddings=False)
+    cfg = LlavaConfig(vision_config=vc.to_dict(), text_config=tc,
+                      image_token_index=255, vision_feature_layer=-2,
+                      vision_feature_select_strategy="default",
+                      projector_hidden_act="gelu", torch_dtype="float32")
+    model = LlavaForConditionalGeneration(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("llava")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def _build_app(d):
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    icfg = ImageToTextInferenceConfig(tcfg,
+                                      load_config=load_pretrained_config(d))
+    app = ImageToTextApplication(d, icfg)
+    app.load_weights()
+    app.init_cache()
+    return app
+
+
+def _prompt(app, rng, b=2, text_len=6):
+    """[text..., image tokens..., text...] with one image per row."""
+    n_img = app.tokens_per_image           # 4 patches for 16/8
+    ids = rng.integers(3, 250, size=(b, text_len + n_img)).astype(np.int64)
+    ids[:, 2:2 + n_img] = 255              # image placeholders
+    return ids
+
+
+def test_vision_features_match_hf(tiny_llava, rng):
+    d, hf = tiny_llava
+    app = _build_app(d)
+    px = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    feats = np.asarray(app.encode_images(px))
+    with torch.no_grad():
+        golden = hf.get_image_features(
+            pixel_values=torch.tensor(px), vision_feature_layer=-2,
+            vision_feature_select_strategy="default")
+        if isinstance(golden, (list, tuple)):
+            golden = torch.cat([g[None] if g.dim() == 2 else g
+                                for g in golden])
+        golden = golden.numpy().reshape(feats.shape)
+    np.testing.assert_allclose(feats, golden, atol=3e-4, rtol=1e-4)
+
+
+def test_llava_prefill_logits_match_hf(tiny_llava, rng):
+    d, hf = tiny_llava
+    app = _build_app(d)
+    px = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    ids = _prompt(app, rng)
+    with torch.no_grad():
+        golden = hf(input_ids=torch.tensor(ids),
+                    pixel_values=torch.tensor(px)).logits.numpy()
+    feats = app.encode_images(px)
+    out = app.text._run_prefill(
+        ids.astype(np.int32), np.full((2,), ids.shape[1], np.int32),
+        image_embeds=feats, image_mask=(ids == 255))
+    np.testing.assert_allclose(np.asarray(out["logits"]), golden,
+                               atol=4e-3, rtol=1e-3)
+
+
+def test_llava_generation_matches_hf(tiny_llava, rng):
+    d, hf = tiny_llava
+    app = _build_app(d)
+    px = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+    ids = _prompt(app, rng)
+    with torch.no_grad():
+        hf_seq = hf.generate(input_ids=torch.tensor(ids),
+                             pixel_values=torch.tensor(px),
+                             max_new_tokens=6, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), px, max_new_tokens=6)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
