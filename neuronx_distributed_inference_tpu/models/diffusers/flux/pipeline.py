@@ -1,0 +1,163 @@
+"""FLUX text-to-image pipeline (reference: models/diffusers/flux/
+application.py:135 ``NeuronFluxApplication`` + pipeline.py — transformer,
+CLIP, T5, VAE submodels orchestrated by a host loop).
+
+Sampler: rectified-flow Euler over shifted sigmas (the flux time-shift
+sigma' = shift*s / (1 + (shift-1)*s)); each denoise step is one jitted
+transformer call; the scan-free host loop mirrors the reference pipeline."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import transformer as ftx
+from . import vae as fvae
+from .text_encoders import (ClipTextSpec, T5Spec, clip_text_forward,
+                            t5_encoder_forward)
+
+
+def shifted_sigmas(num_steps: int, shift: float = 3.0) -> np.ndarray:
+    """Monotone 1 -> 0 sigma schedule with the flux time shift."""
+    s = np.linspace(1.0, 0.0, num_steps + 1, dtype=np.float32)
+    return (shift * s) / (1.0 + (shift - 1.0) * s)
+
+
+def euler_step(x, v, sigma, sigma_next):
+    """Rectified flow: dx/dt = v, x_{t+dt} = x + (sigma_next - sigma) * v."""
+    return x + (sigma_next - sigma) * v
+
+
+@dataclass
+class FluxPipeline:
+    spec: ftx.FluxSpec
+    params: Any                       # flux transformer params
+    clip_spec: ClipTextSpec
+    clip_params: Any
+    t5_spec: T5Spec
+    t5_params: Any
+    vae_spec: fvae.VaeSpec
+    vae_params: Any
+
+    def __post_init__(self):
+        self._flux = jax.jit(partial(ftx.flux_forward, self.spec))
+        self._clip = jax.jit(partial(clip_text_forward, self.clip_spec))
+        self._t5 = jax.jit(partial(t5_encoder_forward, self.t5_spec))
+        self._vae = jax.jit(partial(fvae.vae_decode, self.vae_spec))
+
+    def encode_text(self, clip_ids: np.ndarray, t5_ids: np.ndarray):
+        pooled = self._clip(self.clip_params, jnp.asarray(clip_ids))["pooled"]
+        ctx = self._t5(self.t5_params, jnp.asarray(t5_ids))
+        return ctx, pooled
+
+    def __call__(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
+                 height: int = 64, width: int = 64, num_steps: int = 4,
+                 guidance: float = 3.5, shift: float = 3.0,
+                 seed: int = 0, decode: bool = True) -> Dict[str, Any]:
+        """height/width in pixels (multiples of 16); latents are
+        (h/8, w/8) with 2x2 packing."""
+        b = clip_ids.shape[0]
+        lh, lw = height // 8, width // 8
+        ctx, pooled = self.encode_text(clip_ids, t5_ids)
+        key = jax.random.PRNGKey(seed)
+        lat = jax.random.normal(
+            key, (b, self.vae_spec.latent_channels, lh, lw), jnp.float32)
+        x = ftx.pack_latents(lat)
+        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
+        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
+        g = jnp.full((b,), guidance, jnp.float32)
+
+        sigmas = shifted_sigmas(num_steps, shift)
+        for i in range(num_steps):
+            t = jnp.full((b,), sigmas[i], jnp.float32)
+            v = self._flux(self.params, x, ctx, t, pooled, img_ids, txt_ids,
+                           guidance=g)
+            x = euler_step(x, v, float(sigmas[i]), float(sigmas[i + 1]))
+
+        lat = ftx.unpack_latents(x, lh, lw)
+        out = {"latents": np.asarray(lat), "sigmas": sigmas}
+        if decode:
+            img = self._vae(self.vae_params, lat)
+            out["images"] = np.asarray(img)
+        return out
+
+
+def build_random_pipeline(seed: int = 0, tiny: bool = True) -> FluxPipeline:
+    """Random-weight pipeline for tests/benches (reference analog: tiny
+    random-weight integration configs, SURVEY §4)."""
+    if tiny:
+        spec = ftx.FluxSpec(hidden_size=64, num_heads=4, head_dim=16,
+                            depth_double=2, depth_single=2, in_channels=64,
+                            context_dim=32, pooled_dim=24,
+                            axes_dim=(4, 6, 6))
+        clip_spec = ClipTextSpec(hidden_size=24, num_layers=2, num_heads=2,
+                                 intermediate_size=48, vocab_size=100,
+                                 max_positions=32, eos_token_id=2)
+        t5_spec = T5Spec(d_model=32, num_layers=2, num_heads=2, d_kv=8,
+                         d_ff=64, vocab_size=100)
+        vae_spec = fvae.VaeSpec(latent_channels=16, base_channels=32,
+                                channel_mults=(1, 2), num_res_blocks=1)
+    else:  # flux-dev geometry
+        spec = ftx.FluxSpec()
+        clip_spec = ClipTextSpec(hidden_size=768, num_layers=12, num_heads=12,
+                                 intermediate_size=3072, vocab_size=49408,
+                                 max_positions=77)
+        t5_spec = T5Spec(d_model=4096, num_layers=24, num_heads=64, d_kv=64,
+                         d_ff=10240, vocab_size=32128)
+        vae_spec = fvae.VaeSpec()
+    keys = jax.random.split(jax.random.PRNGKey(seed), 4)
+    from ...model_base import init_param_tree
+    from .text_encoders import clip_text_forward  # noqa: F401
+
+    def init_clip(key):
+        H, L = clip_spec.hidden_size, clip_spec.num_layers
+        I = clip_spec.intermediate_size
+        r = lambda k, *s: jax.random.normal(k, s, jnp.float32) * 0.05
+        ks = jax.random.split(key, 20)
+        layers = {
+            "ln1_w": jnp.ones((L, H)), "ln1_b": jnp.zeros((L, H)),
+            "q_w": r(ks[0], L, H, H), "q_b": jnp.zeros((L, H)),
+            "k_w": r(ks[1], L, H, H), "k_b": jnp.zeros((L, H)),
+            "v_w": r(ks[2], L, H, H), "v_b": jnp.zeros((L, H)),
+            "o_w": r(ks[3], L, H, H), "o_b": jnp.zeros((L, H)),
+            "ln2_w": jnp.ones((L, H)), "ln2_b": jnp.zeros((L, H)),
+            "fc1_w": r(ks[4], L, H, I), "fc1_b": jnp.zeros((L, I)),
+            "fc2_w": r(ks[5], L, I, H), "fc2_b": jnp.zeros((L, H)),
+        }
+        return {"embed": r(ks[6], clip_spec.vocab_size, H),
+                "pos": r(ks[7], clip_spec.max_positions, H),
+                "layers": layers,
+                "ln_f_w": jnp.ones((H,)), "ln_f_b": jnp.zeros((H,))}
+
+    def init_t5(key):
+        s = t5_spec
+        r = lambda k, *sh: jax.random.normal(k, sh, jnp.float32) * 0.05
+        ks = jax.random.split(key, 10)
+        L = s.num_layers
+        inner = s.num_heads * s.d_kv
+        layers = {
+            "ln1": jnp.ones((L, s.d_model)),
+            "q": r(ks[0], L, s.d_model, inner),
+            "k": r(ks[1], L, s.d_model, inner),
+            "v": r(ks[2], L, s.d_model, inner),
+            "o": r(ks[3], L, inner, s.d_model),
+            "ln2": jnp.ones((L, s.d_model)),
+            "wi0": r(ks[4], L, s.d_model, s.d_ff),
+            "wi1": r(ks[5], L, s.d_model, s.d_ff),
+            "wo": r(ks[6], L, s.d_ff, s.d_model),
+        }
+        return {"embed": r(ks[7], s.vocab_size, s.d_model),
+                "rel_bias": r(ks[8], s.rel_buckets, s.num_heads),
+                "layers": layers, "ln_f": jnp.ones((s.d_model,))}
+
+    return FluxPipeline(
+        spec=spec, params=ftx.init_flux_params(spec, keys[0]),
+        clip_spec=clip_spec, clip_params=init_clip(keys[1]),
+        t5_spec=t5_spec, t5_params=init_t5(keys[2]),
+        vae_spec=vae_spec,
+        vae_params=fvae.init_vae_params(vae_spec, keys[3]))

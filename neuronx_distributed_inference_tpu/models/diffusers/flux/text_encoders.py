@@ -1,0 +1,232 @@
+"""FLUX conditioning encoders in JAX: CLIP text (pooled vector) + T5 encoder
+(sequence features) — reference: models/diffusers/flux/ compiles CLIP, T5 and
+the VAE as separate Neuron submodels next to the transformer.
+
+Both are HF-checkpoint compatible and golden-tested against transformers'
+CPU implementations."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...vision import VIT_ACTS
+from ....ops.normalization import layer_norm, rms_norm
+
+
+# ---------------------------------------------------------------------------
+# CLIP text encoder
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ClipTextSpec:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    vocab_size: int
+    max_positions: int
+    eos_token_id: int = 2
+    act: str = "quick_gelu"
+    eps: float = 1e-5
+
+
+def clip_text_spec_from_hf(cfg) -> ClipTextSpec:
+    g = cfg.get if isinstance(cfg, dict) else lambda k, d=None: getattr(cfg, k, d)
+    return ClipTextSpec(
+        hidden_size=g("hidden_size"), num_layers=g("num_hidden_layers"),
+        num_heads=g("num_attention_heads"),
+        intermediate_size=g("intermediate_size"), vocab_size=g("vocab_size"),
+        max_positions=g("max_position_embeddings"),
+        eos_token_id=g("eos_token_id", 2), act=g("hidden_act", "quick_gelu"),
+        eps=g("layer_norm_eps", 1e-5))
+
+
+def clip_text_forward(spec: ClipTextSpec, params, input_ids
+                      ) -> Dict[str, jnp.ndarray]:
+    """Returns {'last_hidden_state', 'pooled'} — pooled = final-LN hidden at
+    each row's eos position (CLIPTextModel pooler semantics)."""
+    b, t = input_ids.shape
+    x = params["embed"][input_ids] + params["pos"][:t]
+    causal = jnp.tril(jnp.ones((t, t), bool))[None]
+    act = VIT_ACTS[spec.act]
+    nh = spec.num_heads
+    scale = (spec.hidden_size // nh) ** -0.5
+
+    def body(h, lw):
+        r = layer_norm(h, lw["ln1_w"], lw["ln1_b"], spec.eps)
+        q = (r @ lw["q_w"] + lw["q_b"]) * scale
+        k = r @ lw["k_w"] + lw["k_b"]
+        v = r @ lw["v_w"] + lw["v_b"]
+        qf = q.reshape(b, t, nh, -1).astype(jnp.float32)
+        kf = k.reshape(b, t, nh, -1).astype(jnp.float32)
+        vf = v.reshape(b, t, nh, -1).astype(jnp.float32)
+        s = jnp.einsum("bthd,bshd->bhts", qf, kf)
+        s = jnp.where(causal[:, None], s, -30000.0)
+        a = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), vf)
+        h = h + (a.reshape(b, t, -1).astype(h.dtype) @ lw["o_w"] + lw["o_b"])
+        r = layer_norm(h, lw["ln2_w"], lw["ln2_b"], spec.eps)
+        h = h + (act(r @ lw["fc1_w"] + lw["fc1_b"]) @ lw["fc2_w"] + lw["fc2_b"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(x, params["ln_f_w"], params["ln_f_b"], spec.eps)
+    if spec.eos_token_id == 2:
+        # HF legacy pooling: position of the HIGHEST token id (for CLIP's
+        # original vocab the eos id 49407 IS the max, so argmax finds it)
+        eos_pos = jnp.argmax(input_ids, axis=1)
+    else:
+        eos_pos = jnp.argmax((input_ids == spec.eos_token_id).astype(jnp.int32),
+                             axis=1)
+    pooled = x[jnp.arange(b), eos_pos]
+    return {"last_hidden_state": x, "pooled": pooled}
+
+
+def convert_clip_text(sd: Dict[str, np.ndarray], spec: ClipTextSpec,
+                      prefix: str = "text_model") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[n], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"{prefix}.encoder.layers.{i}"
+        return {
+            "ln1_w": get(f"{b}.layer_norm1.weight"),
+            "ln1_b": get(f"{b}.layer_norm1.bias"),
+            "q_w": t(get(f"{b}.self_attn.q_proj.weight")),
+            "q_b": get(f"{b}.self_attn.q_proj.bias"),
+            "k_w": t(get(f"{b}.self_attn.k_proj.weight")),
+            "k_b": get(f"{b}.self_attn.k_proj.bias"),
+            "v_w": t(get(f"{b}.self_attn.v_proj.weight")),
+            "v_b": get(f"{b}.self_attn.v_proj.bias"),
+            "o_w": t(get(f"{b}.self_attn.out_proj.weight")),
+            "o_b": get(f"{b}.self_attn.out_proj.bias"),
+            "ln2_w": get(f"{b}.layer_norm2.weight"),
+            "ln2_b": get(f"{b}.layer_norm2.bias"),
+            "fc1_w": t(get(f"{b}.mlp.fc1.weight")),
+            "fc1_b": get(f"{b}.mlp.fc1.bias"),
+            "fc2_w": t(get(f"{b}.mlp.fc2.weight")),
+            "fc2_b": get(f"{b}.mlp.fc2.bias"),
+        }
+
+    layers = [lw(i) for i in range(spec.num_layers)]
+    return {
+        "embed": get(f"{prefix}.embeddings.token_embedding.weight"),
+        "pos": get(f"{prefix}.embeddings.position_embedding.weight"),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+        "ln_f_w": get(f"{prefix}.final_layer_norm.weight"),
+        "ln_f_b": get(f"{prefix}.final_layer_norm.bias"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# T5 encoder
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class T5Spec:
+    d_model: int
+    num_layers: int
+    num_heads: int
+    d_kv: int
+    d_ff: int
+    vocab_size: int
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    eps: float = 1e-6
+
+
+def t5_spec_from_hf(cfg) -> T5Spec:
+    g = cfg.get if isinstance(cfg, dict) else lambda k, d=None: getattr(cfg, k, d)
+    return T5Spec(
+        d_model=g("d_model"), num_layers=g("num_layers"),
+        num_heads=g("num_heads"), d_kv=g("d_kv"), d_ff=g("d_ff"),
+        vocab_size=g("vocab_size"),
+        rel_buckets=g("relative_attention_num_buckets", 32),
+        rel_max_distance=g("relative_attention_max_distance", 128),
+        eps=g("layer_norm_epsilon", 1e-6))
+
+
+def _t5_rel_bucket(rel_pos: jnp.ndarray, num_buckets: int,
+                   max_distance: int) -> jnp.ndarray:
+    """Bidirectional T5 relative position bucketing (HF semantics)."""
+    nb = num_buckets // 2
+    ret = jnp.where(rel_pos > 0, nb, 0)
+    n = jnp.abs(rel_pos)
+    max_exact = nb // 2
+    is_small = n < max_exact
+    log_large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-9)
+        / np.log(max_distance / max_exact) * (nb - max_exact)
+    ).astype(jnp.int32)
+    log_large = jnp.minimum(log_large, nb - 1)
+    return ret + jnp.where(is_small, n, log_large)
+
+
+def t5_encoder_forward(spec: T5Spec, params, input_ids) -> jnp.ndarray:
+    """(B, T) -> (B, T, d_model). Relative-position bias from layer 0 is
+    shared by every layer (T5 convention); attention is unscaled."""
+    b, t = input_ids.shape
+    x = params["embed"][input_ids]
+    pos = jnp.arange(t)
+    rel = pos[None, :] - pos[:, None]                  # mem - query
+    bucket = _t5_rel_bucket(rel, spec.rel_buckets, spec.rel_max_distance)
+    bias = params["rel_bias"][bucket]                  # (T, T, heads)
+    bias = jnp.transpose(bias, (2, 0, 1))[None]        # (1, H, T, T)
+    nh, dk = spec.num_heads, spec.d_kv
+
+    def body(h, lw):
+        r = rms_norm(h, lw["ln1"], spec.eps)
+        q = (r @ lw["q"]).reshape(b, t, nh, dk).astype(jnp.float32)
+        k = (r @ lw["k"]).reshape(b, t, nh, dk).astype(jnp.float32)
+        v = (r @ lw["v"]).reshape(b, t, nh, dk).astype(jnp.float32)
+        s = jnp.einsum("bthd,bshd->bhts", q, k) + bias
+        a = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), v)
+        h = h + (a.reshape(b, t, nh * dk).astype(h.dtype) @ lw["o"])
+        r = rms_norm(h, lw["ln2"], spec.eps)
+        gelu = jax.nn.gelu(r @ lw["wi0"], approximate=True)
+        h = h + ((gelu * (r @ lw["wi1"])) @ lw["wo"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return rms_norm(x, params["ln_f"], spec.eps)
+
+
+def convert_t5_encoder(sd: Dict[str, np.ndarray], spec: T5Spec,
+                       prefix: str = "encoder") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[n], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"{prefix}.block.{i}"
+        return {
+            "ln1": get(f"{b}.layer.0.layer_norm.weight"),
+            "q": t(get(f"{b}.layer.0.SelfAttention.q.weight")),
+            "k": t(get(f"{b}.layer.0.SelfAttention.k.weight")),
+            "v": t(get(f"{b}.layer.0.SelfAttention.v.weight")),
+            "o": t(get(f"{b}.layer.0.SelfAttention.o.weight")),
+            "ln2": get(f"{b}.layer.1.layer_norm.weight"),
+            "wi0": t(get(f"{b}.layer.1.DenseReluDense.wi_0.weight")),
+            "wi1": t(get(f"{b}.layer.1.DenseReluDense.wi_1.weight")),
+            "wo": t(get(f"{b}.layer.1.DenseReluDense.wo.weight")),
+        }
+
+    layers = [lw(i) for i in range(spec.num_layers)]
+    embed_key = "shared.weight" if "shared.weight" in sd else \
+        f"{prefix}.embed_tokens.weight"
+    return {
+        "embed": get(embed_key),
+        "rel_bias": get(f"{prefix}.block.0.layer.0.SelfAttention"
+                        ".relative_attention_bias.weight"),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+        "ln_f": get(f"{prefix}.final_layer_norm.weight"),
+    }

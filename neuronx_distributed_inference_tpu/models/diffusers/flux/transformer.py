@@ -1,0 +1,279 @@
+"""FLUX rectified-flow transformer (reference: models/diffusers/flux/ —
+transformer + pipeline submodels, 4772 LoC total; SURVEY §2.7).
+
+Architecture (MMDiT): double-stream blocks keep image and text tokens in
+separate parameter streams but attend JOINTLY; single-stream blocks run the
+concatenated sequence through a fused qkv+mlp linear. All blocks are
+modulated (adaLN) by the conditioning vector built from the timestep,
+guidance scale and CLIP pooled embedding; positions use 3-axis rope
+(t, h, w) over the latent patch grid.
+
+lax.scan over stacked block weights, same design as the decoder LM stack."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....parallel.layers import ParamSpec
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class FluxSpec:
+    hidden_size: int = 3072          # num_heads * head_dim
+    num_heads: int = 24
+    head_dim: int = 128
+    mlp_ratio: float = 4.0
+    depth_double: int = 19
+    depth_single: int = 38
+    in_channels: int = 64            # packed 2x2 latent patches (16ch VAE)
+    context_dim: int = 4096          # T5 features
+    pooled_dim: int = 768            # CLIP pooled
+    axes_dim: Tuple[int, int, int] = (16, 56, 56)   # rope split per axis
+    guidance_embed: bool = True
+    theta: float = 10000.0
+
+    @property
+    def mlp_hidden(self) -> int:
+        return int(self.hidden_size * self.mlp_ratio)
+
+
+def _linear(h_in, h_out, bias=True):
+    s = {"w": ParamSpec((h_in, h_out), P())}
+    if bias:
+        s["b"] = ParamSpec((h_out,), P(), init="zeros")
+    return s
+
+
+def flux_param_specs(spec: FluxSpec) -> Dict[str, Any]:
+    H, Hm = spec.hidden_size, spec.mlp_hidden
+    D = spec.head_dim
+
+    def stacked(tree, n):
+        def f(ps):
+            return ParamSpec((n,) + ps.shape, P(), ps.dtype, ps.init)
+        return jax.tree.map(f, tree, is_leaf=lambda x: isinstance(x, ParamSpec))
+
+    double = {
+        "img_mod": _linear(H, 6 * H), "txt_mod": _linear(H, 6 * H),
+        "img_qkv": _linear(H, 3 * H), "txt_qkv": _linear(H, 3 * H),
+        "img_qnorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "img_knorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "txt_qnorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "txt_knorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "img_proj": _linear(H, H), "txt_proj": _linear(H, H),
+        "img_mlp1": _linear(H, Hm), "img_mlp2": _linear(Hm, H),
+        "txt_mlp1": _linear(H, Hm), "txt_mlp2": _linear(Hm, H),
+    }
+    single = {
+        "mod": _linear(H, 3 * H),
+        "linear1": _linear(H, 3 * H + Hm),     # qkv + mlp_in fused
+        "qnorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "knorm": {"w": ParamSpec((D,), P(), init="ones")},
+        "linear2": _linear(H + Hm, H),
+    }
+    specs: Dict[str, Any] = {
+        "img_in": _linear(spec.in_channels, H),
+        "txt_in": _linear(spec.context_dim, H),
+        "time_in1": _linear(256, H), "time_in2": _linear(H, H),
+        "vector_in1": _linear(spec.pooled_dim, H), "vector_in2": _linear(H, H),
+        "double": stacked(double, spec.depth_double),
+        "single": stacked(single, spec.depth_single),
+        "final_mod": _linear(H, 2 * H),
+        "final_proj": _linear(H, spec.in_channels),
+    }
+    if spec.guidance_embed:
+        specs["guidance_in1"] = _linear(256, H)
+        specs["guidance_in2"] = _linear(H, H)
+    return specs
+
+
+def init_flux_params(spec: FluxSpec, key, mesh=None):
+    from ...model_base import init_param_tree
+    return init_param_tree(flux_param_specs(spec), key, mesh)
+
+
+def _lin(p, x):
+    y = x @ p["w"]
+    return y + p["b"] if "b" in p else y
+
+
+def timestep_embedding(t: jnp.ndarray, dim: int = 256,
+                       max_period: float = 10000.0) -> jnp.ndarray:
+    """(B,) in [0,1] -> (B, dim) sinusoidal (flux scales t by 1000)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = (t.astype(jnp.float32) * 1000.0)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def rope_3d(ids: jnp.ndarray, axes_dim: Tuple[int, ...], theta: float
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """ids (B, T, 3) -> cos/sin (B, T, head_dim/2): per-axis rotary bands
+    concatenated (flux position encoding over (t, h, w))."""
+    outs_c, outs_s = [], []
+    for i, d in enumerate(axes_dim):
+        freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+        ang = ids[..., i].astype(jnp.float32)[..., None] * freqs
+        outs_c.append(jnp.cos(ang))
+        outs_s.append(jnp.sin(ang))
+    return jnp.concatenate(outs_c, -1), jnp.concatenate(outs_s, -1)
+
+
+def _apply_rope_interleaved(x, cos, sin):
+    """x (B,T,H,D); cos/sin (B,T,D/2); flux rotates interleaved pairs."""
+    b, t, h, d = x.shape
+    xf = x.astype(jnp.float32).reshape(b, t, h, d // 2, 2)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    x1, x2 = xf[..., 0], xf[..., 1]
+    out = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    return out.reshape(b, t, h, d).astype(x.dtype)
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    xf = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    return (xf * w).astype(x.dtype)
+
+
+def _ln(x, eps=1e-6):
+    """Affine-free LayerNorm (flux modulation supplies shift/scale)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.var(xf, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def _attention(q, k, v, cos, sin):
+    """Joint attention: q/k/v (B,T,Hh,D); rope applied to q,k."""
+    q = _apply_rope_interleaved(q, cos, sin)
+    k = _apply_rope_interleaved(k, cos, sin)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+    b, t, h, d = q.shape
+    return o.reshape(b, t, h * d).astype(v.dtype)
+
+
+def flux_forward(spec: FluxSpec, params, img, txt, timestep, pooled,
+                 img_ids, txt_ids, guidance=None):
+    """img (B, T_img, in_channels) packed latents; txt (B, T_txt, 4096);
+    timestep (B,) in [0,1]; pooled (B, 768); ids (B, T, 3).
+    Returns the predicted velocity (B, T_img, in_channels)."""
+    nh, d = spec.num_heads, spec.head_dim
+    vec = _lin(params["time_in2"], jax.nn.silu(
+        _lin(params["time_in1"], timestep_embedding(timestep))))
+    if spec.guidance_embed:
+        g = guidance if guidance is not None else jnp.ones_like(timestep)
+        vec = vec + _lin(params["guidance_in2"], jax.nn.silu(
+            _lin(params["guidance_in1"], timestep_embedding(g))))
+    vec = vec + _lin(params["vector_in2"], jax.nn.silu(
+        _lin(params["vector_in1"], pooled)))
+    vec = jax.nn.silu(vec)[:, None, :]                 # (B,1,H)
+
+    img = _lin(params["img_in"], img)
+    txt = _lin(params["txt_in"], txt)
+    t_txt = txt.shape[1]
+    ids = jnp.concatenate([txt_ids, img_ids], axis=1)
+    cos, sin = rope_3d(ids, spec.axes_dim, spec.theta)
+
+    def split_heads(x):
+        b, t, _ = x.shape
+        return x.reshape(b, t, nh, d)
+
+    def double_body(carry, lw):
+        im, tx = carry
+        im_m = _lin(lw["img_mod"], vec)
+        tx_m = _lin(lw["txt_mod"], vec)
+        i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = jnp.split(im_m, 6, -1)
+        t_sh1, t_sc1, t_g1, t_sh2, t_sc2, t_g2 = jnp.split(tx_m, 6, -1)
+
+        imn = _ln(im) * (1 + i_sc1) + i_sh1
+        txn = _ln(tx) * (1 + t_sc1) + t_sh1
+        iq, ik, iv = jnp.split(_lin(lw["img_qkv"], imn), 3, -1)
+        tq, tk, tv = jnp.split(_lin(lw["txt_qkv"], txn), 3, -1)
+        iq, ik = (_rms(split_heads(iq), lw["img_qnorm"]["w"]),
+                  _rms(split_heads(ik), lw["img_knorm"]["w"]))
+        tq, tk = (_rms(split_heads(tq), lw["txt_qnorm"]["w"]),
+                  _rms(split_heads(tk), lw["txt_knorm"]["w"]))
+        q = jnp.concatenate([tq, iq], axis=1)
+        k = jnp.concatenate([tk, ik], axis=1)
+        v = jnp.concatenate([split_heads(tv), split_heads(iv)], axis=1)
+        attn = _attention(q, k, v, cos, sin)
+        t_attn, i_attn = attn[:, :t_txt], attn[:, t_txt:]
+
+        im = im + i_g1 * _lin(lw["img_proj"], i_attn)
+        tx = tx + t_g1 * _lin(lw["txt_proj"], t_attn)
+        imn = _ln(im) * (1 + i_sc2) + i_sh2
+        txn = _ln(tx) * (1 + t_sc2) + t_sh2
+        im = im + i_g2 * _lin(lw["img_mlp2"], jax.nn.gelu(
+            _lin(lw["img_mlp1"], imn), approximate=True))
+        tx = tx + t_g2 * _lin(lw["txt_mlp2"], jax.nn.gelu(
+            _lin(lw["txt_mlp1"], txn), approximate=True))
+        return (im, tx), None
+
+    (img, txt), _ = jax.lax.scan(double_body, (img, txt), params["double"])
+
+    x = jnp.concatenate([txt, img], axis=1)
+
+    def single_body(h, lw):
+        sh, sc, g = jnp.split(_lin(lw["mod"], vec), 3, -1)
+        hn = _ln(h) * (1 + sc) + sh
+        fused = _lin(lw["linear1"], hn)
+        qkv, mlp = (fused[..., :3 * spec.hidden_size],
+                    fused[..., 3 * spec.hidden_size:])
+        q, k, v = jnp.split(qkv, 3, -1)
+        q = _rms(split_heads(q), lw["qnorm"]["w"])
+        k = _rms(split_heads(k), lw["knorm"]["w"])
+        attn = _attention(q, k, split_heads(v), cos, sin)
+        out = _lin(lw["linear2"], jnp.concatenate(
+            [attn, jax.nn.gelu(mlp, approximate=True)], axis=-1))
+        return h + g * out, None
+
+    x, _ = jax.lax.scan(single_body, x, params["single"])
+    img = x[:, t_txt:]
+
+    sh, sc = jnp.split(_lin(params["final_mod"], jax.nn.silu(vec)), 2, -1)
+    img = _ln(img) * (1 + sc) + sh
+    return _lin(params["final_proj"], img)
+
+
+# ---------------------------------------------------------------------------
+# latent packing + position ids (flux packs 2x2 latent patches)
+# ---------------------------------------------------------------------------
+
+def pack_latents(lat: jnp.ndarray) -> jnp.ndarray:
+    """(B, C, H, W) -> (B, H/2*W/2, C*4)."""
+    b, c, h, w = lat.shape
+    x = lat.reshape(b, c, h // 2, 2, w // 2, 2)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5))
+    return x.reshape(b, (h // 2) * (w // 2), c * 4)
+
+
+def unpack_latents(x: jnp.ndarray, h: int, w: int) -> jnp.ndarray:
+    """(B, H/2*W/2, C*4) -> (B, C, H, W)."""
+    b, _, cc = x.shape
+    c = cc // 4
+    x = x.reshape(b, h // 2, w // 2, c, 2, 2)
+    x = jnp.transpose(x, (0, 3, 1, 4, 2, 5))
+    return x.reshape(b, c, h, w)
+
+
+def make_img_ids(batch: int, h: int, w: int) -> np.ndarray:
+    """(B, H/2*W/2, 3) position ids over the packed patch grid."""
+    hh, ww = h // 2, w // 2
+    ids = np.zeros((hh, ww, 3), np.int32)
+    ids[..., 1] = np.arange(hh)[:, None]
+    ids[..., 2] = np.arange(ww)[None, :]
+    return np.broadcast_to(ids.reshape(1, hh * ww, 3),
+                           (batch, hh * ww, 3)).copy()

@@ -1,0 +1,140 @@
+"""VAE decoder (AutoencoderKL decoder path, FLUX 16-channel variant) —
+reference: the VAE submodel of models/diffusers/flux/ (SURVEY §2.7).
+
+Structure: conv_in -> mid(resnet, attn, resnet) -> up blocks (resnets +
+nearest-2x upsample convs) -> groupnorm/silu/conv_out. GroupNorm(32),
+silu activations. Latents are descaled with (z / scaling_factor +
+shift_factor) before decoding (flux convention)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....parallel.layers import ParamSpec
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class VaeSpec:
+    latent_channels: int = 16
+    base_channels: int = 128
+    channel_mults: Tuple[int, ...] = (1, 2, 4, 4)   # top-down (decoder reversed)
+    num_res_blocks: int = 2
+    out_channels: int = 3
+    groups: int = 32
+    scaling_factor: float = 0.3611
+    shift_factor: float = 0.1159
+
+
+def _conv(cin, cout, k):
+    return {"w": ParamSpec((cout, cin, k, k), P()),
+            "b": ParamSpec((cout,), P(), init="zeros")}
+
+
+def _gn(c):
+    return {"w": ParamSpec((c,), P(), init="ones"),
+            "b": ParamSpec((c,), P(), init="zeros")}
+
+
+def _resnet(cin, cout):
+    s = {"gn1": _gn(cin), "conv1": _conv(cin, cout, 3),
+         "gn2": _gn(cout), "conv2": _conv(cout, cout, 3)}
+    if cin != cout:
+        s["skip"] = _conv(cin, cout, 1)
+    return s
+
+
+def vae_decoder_param_specs(spec: VaeSpec) -> Dict[str, Any]:
+    mults = list(spec.channel_mults)
+    top = spec.base_channels * mults[-1]
+    out: Dict[str, Any] = {
+        "conv_in": _conv(spec.latent_channels, top, 3),
+        "mid_res1": _resnet(top, top),
+        "mid_attn": {"gn": _gn(top), "q": _conv(top, top, 1),
+                     "k": _conv(top, top, 1), "v": _conv(top, top, 1),
+                     "o": _conv(top, top, 1)},
+        "mid_res2": _resnet(top, top),
+        "gn_out": _gn(spec.base_channels * mults[0]),
+        "conv_out": _conv(spec.base_channels * mults[0], spec.out_channels, 3),
+    }
+    cin = top
+    for bi, m in enumerate(reversed(mults)):
+        cout = spec.base_channels * m
+        blk: Dict[str, Any] = {}
+        for ri in range(spec.num_res_blocks + 1):
+            blk[f"res{ri}"] = _resnet(cin if ri == 0 else cout, cout)
+        if bi != len(mults) - 1:
+            blk["upsample"] = _conv(cout, cout, 3)
+        out[f"up{bi}"] = blk
+        cin = cout
+    return out
+
+
+def init_vae_params(spec: VaeSpec, key, mesh=None):
+    from ...model_base import init_param_tree
+    return init_param_tree(vae_decoder_param_specs(spec), key, mesh)
+
+
+def _conv2d(p, x, stride=1, pad=1):
+    dn = ("NCHW", "OIHW", "NCHW")
+    y = jax.lax.conv_general_dilated(
+        x, p["w"], (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=dn)
+    return y + p["b"][None, :, None, None]
+
+
+def _group_norm(p, x, groups):
+    b, c, h, w = x.shape
+    xf = x.astype(jnp.float32).reshape(b, groups, c // groups, h, w)
+    mu = jnp.mean(xf, axis=(2, 3, 4), keepdims=True)
+    var = jnp.var(xf, axis=(2, 3, 4), keepdims=True)
+    xf = ((xf - mu) * jax.lax.rsqrt(var + 1e-6)).reshape(b, c, h, w)
+    return (xf * p["w"][None, :, None, None]
+            + p["b"][None, :, None, None]).astype(x.dtype)
+
+
+def _res_block(p, x, groups):
+    h = _conv2d(p["conv1"], jax.nn.silu(_group_norm(p["gn1"], x, groups)))
+    h = _conv2d(p["conv2"], jax.nn.silu(_group_norm(p["gn2"], h, groups)))
+    skip = _conv2d(p["skip"], x, pad=0) if "skip" in p else x
+    return skip + h
+
+
+def _attn_block(p, x, groups):
+    b, c, hh, ww = x.shape
+    n = _group_norm(p["gn"], x, groups)
+    q = _conv2d(p["q"], n, pad=0).reshape(b, c, hh * ww)
+    k = _conv2d(p["k"], n, pad=0).reshape(b, c, hh * ww)
+    v = _conv2d(p["v"], n, pad=0).reshape(b, c, hh * ww)
+    s = jnp.einsum("bct,bcs->bts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (c ** -0.5)
+    a = jnp.einsum("bts,bcs->bct", jax.nn.softmax(s, -1),
+                   v.astype(jnp.float32)).reshape(b, c, hh, ww)
+    return x + _conv2d(p["o"], a.astype(x.dtype), pad=0)
+
+
+def vae_decode(spec: VaeSpec, params, z: jnp.ndarray) -> jnp.ndarray:
+    """latents (B, C_lat, h, w) -> images (B, 3, 8h, 8w) in [-1, 1]-ish."""
+    g = spec.groups
+    z = z / spec.scaling_factor + spec.shift_factor
+    z = z.astype(params["conv_in"]["w"].dtype)
+    x = _conv2d(params["conv_in"], z)
+    x = _res_block(params["mid_res1"], x, g)
+    x = _attn_block(params["mid_attn"], x, g)
+    x = _res_block(params["mid_res2"], x, g)
+    n_up = len(spec.channel_mults)
+    for bi in range(n_up):
+        blk = params[f"up{bi}"]
+        for ri in range(spec.num_res_blocks + 1):
+            x = _res_block(blk[f"res{ri}"], x, g)
+        if bi != n_up - 1:
+            b, c, hh, ww = x.shape
+            x = jax.image.resize(x, (b, c, hh * 2, ww * 2), "nearest")
+            x = _conv2d(blk["upsample"], x)
+    x = jax.nn.silu(_group_norm(params["gn_out"], x, g))
+    return _conv2d(params["conv_out"], x)

@@ -1,0 +1,115 @@
+"""FLUX pipeline tests (reference: models/diffusers/flux/ — transformer +
+CLIP + T5 + VAE + text2img pipeline). CLIP/T5 are golden-tested vs HF;
+the transformer/VAE (no diffusers in the image) are validated for shape,
+determinism, and sampler math."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.models.diffusers.flux import (
+    FluxPipeline, build_random_pipeline, pack_latents, shifted_sigmas,
+    unpack_latents)
+from neuronx_distributed_inference_tpu.models.diffusers.flux.text_encoders \
+    import (clip_text_forward, clip_text_spec_from_hf, convert_clip_text,
+            convert_t5_encoder, t5_encoder_forward, t5_spec_from_hf)
+
+
+def test_clip_text_matches_hf(rng):
+    from transformers import CLIPTextConfig, CLIPTextModel
+    torch.manual_seed(0)
+    cfg = CLIPTextConfig(hidden_size=32, intermediate_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         vocab_size=99, max_position_embeddings=20,
+                         eos_token_id=2, bos_token_id=1, pad_token_id=0)
+    hf = CLIPTextModel(cfg)
+    hf.eval()
+    spec = clip_text_spec_from_hf(cfg)
+    params = jax.tree.map(jnp.asarray, convert_clip_text(
+        {k: v.numpy() for k, v in hf.state_dict().items()}, spec))
+    ids = rng.integers(3, 90, size=(2, 10)).astype(np.int64)
+    ids[:, -1] = 98         # "eos" = max id (HF legacy argmax pooling)
+    with torch.no_grad():
+        golden = hf(torch.tensor(ids))
+    out = clip_text_forward(spec, params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out["last_hidden_state"]),
+                               golden.last_hidden_state.numpy(),
+                               atol=3e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["pooled"]),
+                               golden.pooler_output.numpy(),
+                               atol=3e-4, rtol=1e-4)
+
+
+def test_t5_encoder_matches_hf(rng):
+    from transformers import T5Config, T5EncoderModel
+    torch.manual_seed(0)
+    cfg = T5Config(d_model=32, d_kv=8, d_ff=64, num_layers=3, num_heads=4,
+                   vocab_size=120, relative_attention_num_buckets=8,
+                   relative_attention_max_distance=20,
+                   feed_forward_proj="gated-gelu")
+    hf = T5EncoderModel(cfg)
+    hf.eval()
+    spec = t5_spec_from_hf(cfg)
+    params = jax.tree.map(jnp.asarray, convert_t5_encoder(
+        {k: v.numpy() for k, v in hf.state_dict().items()}, spec))
+    ids = rng.integers(3, 120, size=(2, 24)).astype(np.int64)
+    with torch.no_grad():
+        golden = hf(torch.tensor(ids)).last_hidden_state.numpy()
+    out = np.asarray(t5_encoder_forward(spec, params, jnp.asarray(ids)))
+    np.testing.assert_allclose(out, golden, atol=3e-4, rtol=1e-4)
+
+
+def test_pack_unpack_roundtrip(rng):
+    lat = rng.normal(size=(2, 16, 8, 12)).astype(np.float32)
+    packed = pack_latents(jnp.asarray(lat))
+    assert packed.shape == (2, 4 * 6, 64)
+    back = np.asarray(unpack_latents(packed, 8, 12))
+    np.testing.assert_array_equal(back, lat)
+
+
+def test_shifted_sigmas_monotone():
+    s = shifted_sigmas(8, shift=3.0)
+    assert s[0] == 1.0 and s[-1] == 0.0
+    assert (np.diff(s) < 0).all()
+    # shift=1 is the identity schedule
+    np.testing.assert_allclose(shifted_sigmas(4, 1.0),
+                               np.linspace(1, 0, 5), atol=1e-7)
+
+
+def test_euler_sampler_exact_on_linear_field():
+    """For v(x,t) = c (constant velocity), euler integration from sigma=1
+    to 0 must move x by exactly -c (rectified flow transport)."""
+    from neuronx_distributed_inference_tpu.models.diffusers.flux.pipeline \
+        import euler_step
+    x = jnp.ones((2, 3))
+    c = jnp.full((2, 3), 2.0)
+    sig = shifted_sigmas(7, shift=2.5)
+    for i in range(7):
+        x = euler_step(x, c, float(sig[i]), float(sig[i + 1]))
+    np.testing.assert_allclose(np.asarray(x), 1.0 - 2.0, atol=1e-6)
+
+
+@pytest.fixture(scope="module")
+def tiny_pipe():
+    return build_random_pipeline(seed=0)
+
+
+def test_flux_pipeline_end_to_end(tiny_pipe, rng):
+    clip_ids = rng.integers(3, 100, size=(1, 8)).astype(np.int32)
+    t5_ids = rng.integers(3, 100, size=(1, 12)).astype(np.int32)
+    out = tiny_pipe(clip_ids, t5_ids, height=32, width=32, num_steps=2,
+                    decode=True)
+    assert out["latents"].shape == (1, 16, 4, 4)
+    assert out["images"].shape == (1, 3, 8, 8)   # 2x upsample in tiny vae
+    assert np.isfinite(out["images"]).all()
+    # deterministic under a fixed seed
+    out2 = tiny_pipe(clip_ids, t5_ids, height=32, width=32, num_steps=2,
+                     decode=False)
+    np.testing.assert_array_equal(out["latents"], out2["latents"])
+    # guidance conditioning actually changes the result
+    out3 = tiny_pipe(clip_ids, t5_ids, height=32, width=32, num_steps=2,
+                     guidance=9.0, decode=False)
+    assert not np.allclose(out["latents"], out3["latents"])
