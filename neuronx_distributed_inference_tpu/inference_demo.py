@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
                           "or no tokenizer available")
     run.add_argument("--tp-degree", type=int, default=1)
     run.add_argument("--cp-degree", type=int, default=1)
+    run.add_argument("--ep-degree", type=int, default=1)
+    run.add_argument("--attention-dp-degree", type=int, default=1)
+    run.add_argument("--sequence-parallel", action="store_true")
+    run.add_argument("--flash-decoding", action="store_true")
     run.add_argument("--batch-size", type=int, default=1)
     run.add_argument("--max-context-length", type=int, default=128)
     run.add_argument("--seq-len", type=int, default=256)
@@ -50,6 +54,30 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--no-bucketing", dest="enable_bucketing",
                      action="store_false")
     run.add_argument("--decode-chunk-tokens", type=int, default=1)
+    # quantization (reference: models/config.py:216-241)
+    run.add_argument("--quantized", action="store_true")
+    run.add_argument("--quantization-dtype", default="int8",
+                     choices=["int8", "fp8", "mxfp4"])
+    run.add_argument("--quantization-type", default="per_channel_symmetric",
+                     choices=["per_channel_symmetric", "per_tensor_symmetric"])
+    run.add_argument("--kv-cache-dtype", default=None)
+    run.add_argument("--kv-cache-quant", action="store_true")
+    # paged KV / prefix caching / chunked prefill
+    run.add_argument("--block-kv", action="store_true",
+                     help="paged (block) KV cache layout")
+    run.add_argument("--prefix-caching", action="store_true")
+    run.add_argument("--chunked-prefill", action="store_true")
+    run.add_argument("--pa-block-size", type=int, default=32)
+    # speculation (reference: --speculation-length / --draft-model-path)
+    run.add_argument("--speculation-length", type=int, default=0)
+    run.add_argument("--draft-model-path", default=None)
+    # LoRA serving
+    run.add_argument("--lora-ckpt", action="append", default=None,
+                     metavar="NAME=PATH", help="PEFT adapter dir, repeatable")
+    run.add_argument("--max-loras", type=int, default=4)
+    run.add_argument("--max-lora-rank", type=int, default=16)
+    run.add_argument("--adapter-id", type=int, default=None,
+                     help="adapter slot used for this run's requests")
     # sampling
     run.add_argument("--on-device-sampling", action="store_true")
     run.add_argument("--do-sample", action="store_true")
@@ -83,9 +111,11 @@ def _force_cpu(n: int = 8):
 def run_inference(args) -> int:
     if args.on_cpu:
         _force_cpu(max(args.tp_degree, 8))
-    from .config import (InferenceConfig, OnDeviceSamplingConfig, TpuConfig,
+    from .config import (InferenceConfig, LoraServingConfig,
+                         OnDeviceSamplingConfig, SpeculationConfig, TpuConfig,
                          load_pretrained_config)
-    from .models.application import CausalLMApplication
+    from .models.application import (CausalLMApplication,
+                                     PagedCausalLMApplication)
     from .models.family import get_family
 
     sampling_cfg = None
@@ -93,15 +123,51 @@ def run_inference(args) -> int:
         sampling_cfg = OnDeviceSamplingConfig(
             do_sample=args.do_sample, top_k=args.top_k, top_p=args.top_p,
             temperature=args.temperature)
-    tcfg = TpuConfig(
-        batch_size=args.batch_size, seq_len=args.seq_len,
-        max_context_length=args.max_context_length, dtype=args.dtype,
-        tp_degree=args.tp_degree, cp_degree=args.cp_degree,
-        enable_bucketing=args.enable_bucketing,
-        decode_chunk_tokens=args.decode_chunk_tokens,
-        on_device_sampling_config=sampling_cfg,
-        output_logits=args.check_accuracy_mode == "logit-matching",
-        compile_cache_dir=args.compiled_model_path, seed=args.seed)
+    lora_cfg = None
+    lora_paths = {}
+    if args.lora_ckpt:
+        for item in args.lora_ckpt:
+            name, _, path = item.partition("=")
+            lora_paths[name] = path
+        lora_cfg = LoraServingConfig(max_loras=args.max_loras,
+                                     max_lora_rank=args.max_lora_rank,
+                                     lora_ckpt_paths=lora_paths)
+    spec_cfg = None
+    if args.speculation_length > 0:
+        spec_cfg = SpeculationConfig(
+            speculation_length=args.speculation_length,
+            enable_fused_speculation=True,
+            draft_model_path=args.draft_model_path)
+
+    def make_tcfg(**over):
+        kw = dict(
+            batch_size=args.batch_size, seq_len=args.seq_len,
+            max_context_length=args.max_context_length, dtype=args.dtype,
+            tp_degree=args.tp_degree, cp_degree=args.cp_degree,
+            ep_degree=args.ep_degree,
+            attention_dp_degree=args.attention_dp_degree,
+            sequence_parallel_enabled=args.sequence_parallel,
+            flash_decoding_enabled=args.flash_decoding,
+            enable_bucketing=args.enable_bucketing,
+            decode_chunk_tokens=args.decode_chunk_tokens,
+            on_device_sampling_config=sampling_cfg,
+            quantized=args.quantized,
+            quantization_dtype=args.quantization_dtype,
+            quantization_type=args.quantization_type,
+            kv_cache_dtype=args.kv_cache_dtype,
+            kv_cache_quant=args.kv_cache_quant,
+            is_block_kv_layout=args.block_kv or args.prefix_caching
+            or args.chunked_prefill,
+            is_prefix_caching=args.prefix_caching,
+            is_chunked_prefill=args.chunked_prefill,
+            pa_block_size=args.pa_block_size,
+            lora_config=lora_cfg,
+            output_logits=args.check_accuracy_mode == "logit-matching",
+            compile_cache_dir=args.compiled_model_path, seed=args.seed)
+        kw.update(over)
+        return TpuConfig(**kw)
+
+    tcfg = make_tcfg(speculation_config=spec_cfg)
 
     # model family from config.json unless overridden
     with open(os.path.join(args.model_path, "config.json")) as f:
@@ -109,14 +175,35 @@ def run_inference(args) -> int:
     family = get_family(model_type)
     icfg = family.config_cls(tcfg,
                              load_config=load_pretrained_config(args.model_path))
-    app = CausalLMApplication(args.model_path, icfg, family)
+    app_cls = (PagedCausalLMApplication if tcfg.is_block_kv_layout
+               else CausalLMApplication)
+    app = app_cls(args.model_path, icfg, family)
     if args.random_weights:
         app.init_random_weights(args.seed)
     else:
         app.load_weights()
     app.init_cache()
+    if lora_cfg is not None and lora_paths and not args.random_weights:
+        app.load_lora_adapters(lora_paths)
     if args.compiled_model_path:
         app.compile(args.compiled_model_path)
+
+    decoder = None
+    if spec_cfg is not None and args.draft_model_path:
+        from .models.speculation import SpeculativeDecoder
+        with open(os.path.join(args.draft_model_path, "config.json")) as f:
+            draft_type = json.load(f).get("model_type")
+        d_family = get_family(draft_type)
+        d_icfg = d_family.config_cls(
+            make_tcfg(speculation_config=spec_cfg),
+            load_config=load_pretrained_config(args.draft_model_path))
+        draft = CausalLMApplication(args.draft_model_path, d_icfg, d_family)
+        if args.random_weights:
+            draft.init_random_weights(args.seed + 1)
+        else:
+            draft.load_weights()
+        draft.init_cache()
+        decoder = SpeculativeDecoder(app, draft)
 
     # build input ids: tokenizer if available, else random tokens
     tokenizer = None
@@ -140,9 +227,20 @@ def run_inference(args) -> int:
             dtype=np.int32)
         attention_mask = np.ones_like(input_ids)
 
-    res = app.generate(input_ids, attention_mask=attention_mask,
-                       max_new_tokens=args.max_new_tokens, eos_token_id=eos)
-    print(f"TTFT: {res['ttft_s'] * 1e3:.1f} ms")
+    gen_kwargs = {}
+    if args.adapter_id is not None:
+        gen_kwargs["adapter_ids"] = np.full((args.batch_size,),
+                                            args.adapter_id, np.int32)
+    if decoder is not None:
+        res = decoder.generate(input_ids, max_new_tokens=args.max_new_tokens,
+                               eos_token_id=eos,
+                               attention_mask=attention_mask)
+        print(f"speculation: {res['mean_tokens_per_step']:.2f} tokens/step")
+    else:
+        res = app.generate(input_ids, attention_mask=attention_mask,
+                           max_new_tokens=args.max_new_tokens,
+                           eos_token_id=eos, **gen_kwargs)
+        print(f"TTFT: {res['ttft_s'] * 1e3:.1f} ms")
     for i, row in enumerate(res["sequences"]):
         if tokenizer is not None:
             print(f"--- output {i} ---")

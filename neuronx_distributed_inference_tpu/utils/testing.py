@@ -1,0 +1,147 @@
+"""Testing harness (reference: utils/testing.py — ``build_module`` /
+``build_function`` :123-268 micro-compile helpers, ``validate_accuracy``
+:67-121, ``init_cpu_env``/``destroy_cpu_env`` :40-64 fake-distributed CPU
+backend; SURVEY §4).
+
+TPU equivalents: the fake-distributed backend is just JAX's virtual CPU
+devices; build_function is an AOT jit lower+compile wrapper; accuracy
+validation compares a device callable against a CPU/golden callable with
+the reference's assert_close semantics."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def init_cpu_env(num_devices: int = 8) -> int:
+    """Force the virtual-CPU backend with ``num_devices`` devices
+    (reference: init_cpu_env's gloo world + NXD_CPU_MODE). Must run before
+    the JAX backend initializes; returns the device count actually live."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={num_devices}"
+        ).strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", num_devices)
+    except RuntimeError:
+        pass
+    return len(jax.devices())
+
+
+def destroy_cpu_env() -> None:
+    """Kept for API parity (JAX needs no teardown; the reference destroys
+    its gloo process group here)."""
+
+
+def build_function(fn: Callable, example_args: Sequence[Any],
+                   static_argnums: Tuple[int, ...] = (),
+                   donate_argnums: Tuple[int, ...] = (),
+                   mesh=None) -> Callable:
+    """AOT-compile a bare function at the example input shapes
+    (reference: build_function — one-off ModelBuilder trace+compile).
+    Returns the compiled executable (callable with matching shapes)."""
+    import jax
+    jitted = jax.jit(fn, static_argnums=static_argnums,
+                     donate_argnums=donate_argnums)
+    if mesh is not None:
+        with jax.sharding.set_mesh(mesh):
+            return jitted.lower(*example_args).compile()
+    return jitted.lower(*example_args).compile()
+
+
+def build_module(module_fn: Callable, params: Any,
+                 example_args: Sequence[Any], mesh=None) -> Callable:
+    """Compile ``module_fn(params, *args)`` with params closed over —
+    the functional analog of the reference's nn.Module build_module."""
+    compiled = build_function(module_fn, (params, *example_args), mesh=mesh)
+    return lambda *args: compiled(params, *args)
+
+
+def assert_close(actual, expected, rtol: float = 1.6e-2,
+                 atol: float = 1e-5, msg: str = ""):
+    """Dtype-aware closeness (reference: torch_neuronx assert_close usage —
+    loose default rtol for bf16-class comparisons)."""
+    a = np.asarray(actual, np.float32)
+    e = np.asarray(expected, np.float32)
+    np.testing.assert_allclose(a, e, rtol=rtol, atol=atol, err_msg=msg)
+
+
+@dataclasses.dataclass
+class AccuracyReport:
+    passed: bool
+    max_abs_err: float
+    max_rel_err: float
+    num_mismatched: int
+    message: str = ""
+
+    def __str__(self) -> str:
+        s = "PASS" if self.passed else "FAIL"
+        return (f"validate_accuracy: {s} max_abs={self.max_abs_err:.3e} "
+                f"max_rel={self.max_rel_err:.3e} "
+                f"mismatched={self.num_mismatched} {self.message}")
+
+
+def validate_accuracy(device_fn: Callable, inputs: Sequence[Any],
+                      cpu_callable: Optional[Callable] = None,
+                      golden: Any = None, rtol: float = 1.6e-2,
+                      atol: float = 1e-5) -> AccuracyReport:
+    """Run ``device_fn(*inputs)`` and compare against a CPU callable and/or
+    a precomputed golden (reference: validate_accuracy :67-121 compares
+    device vs cpu vs golden)."""
+    import jax
+    actual = jax.device_get(device_fn(*inputs))
+    if golden is None:
+        if cpu_callable is None:
+            raise ValueError("need cpu_callable or golden")
+        golden = cpu_callable(*inputs)
+    flat_a = np.concatenate([np.ravel(np.asarray(x, np.float32))
+                             for x in jax.tree.leaves(actual)])
+    flat_g = np.concatenate([np.ravel(np.asarray(x, np.float32))
+                             for x in jax.tree.leaves(golden)])
+    abs_err = np.abs(flat_a - flat_g)
+    denom = np.maximum(np.abs(flat_g), 1e-9)
+    rel_err = abs_err / denom
+    bad = abs_err > (atol + rtol * np.abs(flat_g))
+    return AccuracyReport(
+        passed=not bad.any(),
+        max_abs_err=float(abs_err.max(initial=0.0)),
+        max_rel_err=float(rel_err.max(initial=0.0)),
+        num_mismatched=int(bad.sum()),
+    )
+
+
+def make_tiny_checkpoint(tmp_dir: str, model_type: str = "llama",
+                         num_layers: int = 4, **config_over) -> str:
+    """Save a tiny random-weight HF checkpoint (reference: the N-layer
+    random checkpoint creation, modules/checkpoint.py:202-287, and the
+    tiny integration configs of SURVEY §4)."""
+    import torch
+    import transformers
+    cls_map = {
+        "llama": (transformers.LlamaConfig, transformers.LlamaForCausalLM),
+        "mistral": (transformers.MistralConfig,
+                    transformers.MistralForCausalLM),
+        "qwen2": (transformers.Qwen2Config, transformers.Qwen2ForCausalLM),
+        "qwen3": (transformers.Qwen3Config, transformers.Qwen3ForCausalLM),
+    }
+    cfg_cls, model_cls = cls_map[model_type]
+    kw = dict(hidden_size=64, intermediate_size=128,
+              num_hidden_layers=num_layers, num_attention_heads=4,
+              num_key_value_heads=2, vocab_size=512, rms_norm_eps=1e-5,
+              max_position_embeddings=256, tie_word_embeddings=False,
+              torch_dtype="float32")
+    kw.update(config_over)
+    torch.manual_seed(0)
+    model = model_cls(cfg_cls(**kw))
+    model.eval()
+    model.save_pretrained(tmp_dir, safe_serialization=True)
+    return tmp_dir

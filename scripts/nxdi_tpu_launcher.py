@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Multi-host launcher (reference: scripts/nxdi_distributed_launcher.py:29-85
+— mpirun + NEURON_RT_ROOT_COMM_ID bootstrap; SURVEY §3.5).
+
+TPU equivalent: ``jax.distributed.initialize`` over DCN. One process per
+host; rank/coordinator come from flags or the environment
+(NXDI_TPU_COORDINATOR / NXDI_TPU_NUM_PROCESSES / NXDI_TPU_PROCESS_ID, with
+SLURM_* fallbacks). After initialization the target module runs with
+jax.devices() spanning every host's chips.
+
+Usage:
+  python scripts/nxdi_tpu_launcher.py --coordinator host0:8476 \
+      --num-processes 4 --process-id $RANK \
+      -m neuronx_distributed_inference_tpu.inference_demo run ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(prog="nxdi_tpu_launcher")
+    p.add_argument("--coordinator", default=os.environ.get(
+        "NXDI_TPU_COORDINATOR"))
+    p.add_argument("--num-processes", type=int, default=int(os.environ.get(
+        "NXDI_TPU_NUM_PROCESSES",
+        os.environ.get("SLURM_NTASKS", "1"))))
+    p.add_argument("--process-id", type=int, default=int(os.environ.get(
+        "NXDI_TPU_PROCESS_ID", os.environ.get("SLURM_PROCID", "0"))))
+    p.add_argument("--local-device-ids", default=None,
+                   help="comma-separated device ids bound to this process")
+    p.add_argument("-m", "--module", required=True,
+                   help="python module to run after distributed init")
+    return p.parse_known_args(argv)
+
+
+def main(argv=None) -> int:
+    args, rest = parse_args(argv)
+    import jax
+    if args.num_processes > 1:
+        kwargs = dict(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        if args.local_device_ids:
+            kwargs["local_device_ids"] = [
+                int(x) for x in args.local_device_ids.split(",")]
+        jax.distributed.initialize(**kwargs)
+        print(f"[launcher] process {jax.process_index()}/{jax.process_count()}"
+              f" local_devices={len(jax.local_devices())}"
+              f" global_devices={len(jax.devices())}")
+    sys.argv = [args.module] + rest
+    runpy.run_module(args.module, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

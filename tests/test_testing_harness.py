@@ -1,0 +1,92 @@
+"""Tests for the testing harness itself + extended CLI flags (reference:
+utils/testing.py harness, inference_demo argparse mirror :99-408)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from neuronx_distributed_inference_tpu.utils import testing as th
+
+
+def test_build_function_compiles_and_runs():
+    def f(x, y):
+        return x @ y + 1.0
+
+    x = jnp.ones((4, 8))
+    y = jnp.ones((8, 2))
+    compiled = th.build_function(f, (x, y))
+    out = compiled(x, y)
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 2), 9.0))
+
+
+def test_build_module_closes_over_params():
+    params = {"w": jnp.full((3, 3), 2.0)}
+
+    def mod(p, x):
+        return x @ p["w"]
+
+    fn = th.build_module(mod, params, (jnp.ones((2, 3)),))
+    np.testing.assert_allclose(np.asarray(fn(jnp.ones((2, 3)))),
+                               np.full((2, 3), 6.0))
+
+
+def test_validate_accuracy_pass_and_fail(rng):
+    x = jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))
+
+    def dev(a):
+        return a * 2.0
+
+    rep = th.validate_accuracy(dev, (x,), cpu_callable=lambda a: np.asarray(a) * 2.0)
+    assert rep.passed and rep.num_mismatched == 0
+    rep2 = th.validate_accuracy(dev, (x,), golden=np.asarray(x) * 2.0 + 0.5)
+    assert not rep2.passed
+    assert "FAIL" in str(rep2)
+
+
+def test_make_tiny_checkpoint_loads(tmp_path):
+    d = th.make_tiny_checkpoint(str(tmp_path / "m"), "llama", num_layers=2)
+    from neuronx_distributed_inference_tpu.utils.checkpoint import \
+        load_state_dict
+    sd = load_state_dict(d)
+    assert "model.embed_tokens.weight" in sd
+
+
+@pytest.mark.parametrize("extra", [
+    [],
+    ["--quantized", "--quantization-dtype", "int8"],
+    ["--block-kv", "--prefix-caching", "--pa-block-size", "16"],
+])
+def test_cli_run_with_feature_flags(tmp_path, extra):
+    """The CLI drives the full app on CPU with each feature set
+    (reference: inference_demo run flow :493-680)."""
+    d = th.make_tiny_checkpoint(str(tmp_path / "m"), "llama", num_layers=2)
+    cmd = [sys.executable, "-m",
+           "neuronx_distributed_inference_tpu.inference_demo",
+           "run", "--model-path", d, "--on-cpu", "--no-bucketing",
+           "--batch-size", "2", "--prompt-len", "8",
+           "--max-context-length", "16", "--seq-len", "32",
+           "--dtype", "float32", "--max-new-tokens", "4"] + extra
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300,
+                       cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "--- output 0 ---" in r.stdout
+
+
+def test_cli_speculation_flag(tmp_path):
+    d = th.make_tiny_checkpoint(str(tmp_path / "m"), "llama", num_layers=2)
+    dr = th.make_tiny_checkpoint(str(tmp_path / "d"), "llama", num_layers=1)
+    cmd = [sys.executable, "-m",
+           "neuronx_distributed_inference_tpu.inference_demo",
+           "run", "--model-path", d, "--draft-model-path", dr,
+           "--speculation-length", "2", "--on-cpu", "--no-bucketing",
+           "--batch-size", "2", "--prompt-len", "8",
+           "--max-context-length", "16", "--seq-len", "48",
+           "--dtype", "float32", "--max-new-tokens", "6"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300,
+                       cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "tokens/step" in r.stdout
