@@ -4,7 +4,11 @@ from . import family  # noqa: F401
 from .llama import modeling_llama  # noqa: F401
 from .dbrx import modeling_dbrx  # noqa: F401
 from .deepseek import modeling_deepseek  # noqa: F401
+from .gemma2 import modeling_gemma2  # noqa: F401
 from .gemma3 import modeling_gemma3  # noqa: F401
+from .granite import modeling_granite  # noqa: F401
+from .olmo2 import modeling_olmo2  # noqa: F401
+from .phi3 import modeling_phi3  # noqa: F401
 from .gpt_oss import modeling_gpt_oss  # noqa: F401
 from .mistral import modeling_mistral  # noqa: F401
 from .mixtral import modeling_mixtral  # noqa: F401

@@ -1,0 +1,2 @@
+from .modeling_gemma2 import (Gemma2Family, Gemma2InferenceConfig,
+                            TpuGemma2ForCausalLM)

@@ -1,0 +1,63 @@
+"""Gemma2 family (reference analog: contrib gemma models — SURVEY §2.7
+contrib hub). Gemma3's sibling: same sandwich norms / (1+w) RMSNorm /
+sqrt(H) embed scale / alternating sliding layers, but a single rope theta
+and attn+final logit softcapping."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..gemma3.modeling_gemma3 import Gemma3Family
+from ..model_base import DecoderSpec, spec_from_config
+
+
+class Gemma2InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size",
+                "head_dim", "sliding_window"]
+
+
+@register_family("gemma2")
+class Gemma2Family(DecoderFamily):
+    config_cls = Gemma2InferenceConfig
+    post_norm_src = "pre_feedforward_layernorm"
+    # sandwich-norm weights load identically to gemma3
+    convert_extra_layer_weights = Gemma3Family.convert_extra_layer_weights
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        n_layers = config.num_hidden_layers
+        layer_types = getattr(config, "layer_types", None)
+        if layer_types is None:
+            pattern_n = getattr(config, "sliding_window_pattern", 2)
+            layer_types = ["sliding_attention" if (i + 1) % pattern_n else
+                           "full_attention" for i in range(n_layers)]
+        pattern = tuple(t == "sliding_attention" for t in layer_types)
+        scalar = float(getattr(config, "query_pre_attn_scalar",
+                               config.head_dim))
+        return spec_from_config(
+            config, tp_degree,
+            sliding_window=int(config.sliding_window),
+            layer_pattern=pattern,
+            sandwich_norm=True,
+            norm_offset=1.0,
+            attn_scale=scalar ** -0.5,
+            embed_scale=math.sqrt(config.hidden_size),
+            logits_soft_cap=getattr(config, "final_logit_softcapping", 30.0),
+            attn_soft_cap=getattr(config, "attn_logit_softcapping", 50.0),
+            act=getattr(config, "hidden_activation", "gelu_pytorch_tanh"),
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             True)),
+        )
+
+
+def TpuGemma2ForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, Gemma2Family)

@@ -1,0 +1,2 @@
+from .modeling_granite import (GraniteFamily, GraniteInferenceConfig,
+                            TpuGraniteForCausalLM)

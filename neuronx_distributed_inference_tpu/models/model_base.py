@@ -99,6 +99,15 @@ class DecoderSpec:
     o_bias: bool = False
     mlp_bias: bool = False
     qk_norm: bool = False     # qwen3-style per-head q/k RMSNorm
+    # olmo2-style FULL-width q/k RMSNorm (over nq*D / nkv*D, pre head-split)
+    qk_norm_full: bool = False
+    # "pre" (llama default) or "post" (olmo2: norms on the block OUTPUTS via
+    # the sandwich weights, no pre-norms)
+    norm_position: str = "pre"
+    # granite multipliers: residual_multiplier scales each block output
+    # before the residual add; logits_divide divides the lm-head logits
+    residual_multiplier: float = 1.0
+    logits_divide: Optional[float] = None
     tie_word_embeddings: bool = False
     sliding_window: int = 0   # 0 = full attention
     logits_soft_cap: Optional[float] = None
@@ -235,6 +244,11 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
         if spec.qk_norm:
             layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
             layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
+        if spec.qk_norm_full:
+            layers["q_norm"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP),
+                                         dt, "ones")
+            layers["k_norm"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP),
+                                         dt, "ones")
     if spec.o_bias:
         # row-parallel bias: replicated, added after the psum'd projection
         layers["o_bias"] = ParamSpec((L, H), P(), dt, "zeros")
@@ -508,7 +522,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     else:
         cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
     sink = layer_w["sink"] if spec.attn_sink else None
-    h = _norm(spec, hidden, layer_w["input_norm"])
+    h = (_norm(spec, hidden, layer_w["input_norm"])
+         if spec.norm_position == "pre" else hidden)
     if spec.mla is not None:
         q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
     else:
@@ -522,6 +537,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
             q = q + layer_w["q_bias"]
             k = k + layer_w["k_bias"]
             v = v + layer_w["v_bias"]
+        if spec.qk_norm_full:
+            # olmo2: RMSNorm over the whole projection, pre head-split
+            q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+            k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         if spec.qkv_clip is not None:
             q = jnp.clip(q, -spec.qkv_clip, spec.qkv_clip)
             k = jnp.clip(k, -spec.qkv_clip, spec.qkv_clip)
@@ -608,9 +627,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     # SP: residual stream stays seq-sharded between blocks during prefill
     # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
     sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
-    hidden = hidden + _shard(h, AXIS_DP, sp_axis, None)
+    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
 
-    h = _norm(spec, hidden, layer_w["post_norm"])
+    h = (_norm(spec, hidden, layer_w["post_norm"])
+         if spec.norm_position == "pre" else hidden)
     if mlp_kind == "moe":
         h = moe_block(spec.moe, h, layer_w)
     else:
@@ -625,7 +645,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
     h = _tap("mlp_output", h)
-    hidden = hidden + _shard(h, AXIS_DP, sp_axis, None)
+    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
     hidden = _tap("layer_output", hidden)
     return hidden, new_k, new_v, caps
 
@@ -701,6 +721,8 @@ def _lm_head(spec: DecoderSpec, params, hidden):
     h = _norm(spec, hidden, params["final_norm"])
     w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     logits = (h @ w).astype(jnp.float32)
+    if spec.logits_divide:
+        logits = logits / spec.logits_divide
     if spec.logits_soft_cap:
         logits = spec.logits_soft_cap * jnp.tanh(logits / spec.logits_soft_cap)
     logits = sampling_ops.mask_padded_logits(logits, spec.padded_vocab - spec.vocab_size)

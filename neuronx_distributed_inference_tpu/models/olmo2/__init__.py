@@ -1,0 +1,2 @@
+from .modeling_olmo2 import (Olmo2Family, Olmo2InferenceConfig,
+                            TpuOlmo2ForCausalLM)

@@ -1,0 +1,82 @@
+"""OLMo2 family (reference analog: contrib olmo models — SURVEY §2.7).
+POST-norm architecture: no pre-norms; RMSNorm applied to the attention and
+MLP OUTPUTS before the residual add; full-width q/k RMSNorm pre head-split.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+from ...parallel.layers import place_q_weight, replicate_kv_weight
+
+
+class Olmo2InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size"]
+
+
+@register_family("olmo2")
+class Olmo2Family(DecoderFamily):
+    config_cls = Olmo2InferenceConfig
+    # the spec's pre-MLP "post_norm" slot is unused in post-norm mode; feed it
+    # the post_attention weights so the base converter finds a real tensor
+    post_norm_src = "post_attention_layernorm"
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
+                   ) -> DecoderSpec:
+        return spec_from_config(
+            config, tp_degree,
+            norm_position="post",
+            sandwich_norm=True,       # provides post_attn/post_ff norm slots
+            qk_norm_full=True,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray], spec: DecoderSpec
+                              ) -> Dict[str, np.ndarray]:
+        # olmo2 has no input_layernorm; the (unused) pre-norm slots load ones
+        aug = dict(sd)
+        H = spec.hidden_size
+        for i in range(spec.num_layers):
+            aug[f"model.layers.{i}.input_layernorm.weight"] = np.ones(
+                (H,), np.float32)
+        return super().convert_hf_state_dict(aug, spec)
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec: DecoderSpec
+                                    ) -> Dict[str, np.ndarray]:
+        g = spec.gqa
+        D = spec.head_dim
+        p = cls.hf_prefix
+
+        def ident(w):
+            return np.asarray(w)
+
+        def q_n(w):   # full-width norm weight follows the padded q layout
+            return place_q_weight(np.asarray(w), g, D)
+
+        def kv_n(w):
+            return replicate_kv_weight(np.asarray(w), g, D)
+
+        return {
+            "post_attn_norm": layer_stack(
+                p + ".layers.{i}.post_attention_layernorm.weight", ident),
+            "post_ff_norm": layer_stack(
+                p + ".layers.{i}.post_feedforward_layernorm.weight", ident),
+            "q_norm": layer_stack(p + ".layers.{i}.self_attn.q_norm.weight",
+                                  q_n),
+            "k_norm": layer_stack(p + ".layers.{i}.self_attn.k_norm.weight",
+                                  kv_n),
+        }
+
+
+def TpuOlmo2ForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, Olmo2Family)

@@ -1,0 +1,2 @@
+from .modeling_phi3 import (Phi3Family, Phi3InferenceConfig,
+                            TpuPhi3ForCausalLM)

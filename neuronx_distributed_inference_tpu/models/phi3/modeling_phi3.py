@@ -1,0 +1,54 @@
+"""Phi-3 family (reference analog: contrib phi models — SURVEY §2.7).
+Llama-shaped with FUSED projections: qkv_proj (q|k|v halves) and
+gate_up_proj (gate|up halves, chunked not interleaved); no biases."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...config import InferenceConfig
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec
+from ...parallel.layers import place_q_weight, replicate_kv_weight
+
+
+class Phi3InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size"]
+
+
+@register_family("phi3", "phi4")
+class Phi3Family(DecoderFamily):
+    config_cls = Phi3InferenceConfig
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray], spec: DecoderSpec
+                              ) -> Dict[str, np.ndarray]:
+        """Split the fused projections into the standard layout, then let the
+        base converter do the rest."""
+        g = spec.gqa
+        D = spec.head_dim
+        nq, nkv = g.orig_q_heads * D, g.orig_kv_heads * D
+        I = spec.intermediate_size
+        split = dict(sd)
+        for k in list(sd):
+            if k.endswith("self_attn.qkv_proj.weight"):
+                w = np.asarray(sd[k])
+                base = k[: -len("qkv_proj.weight")]
+                split[base + "q_proj.weight"] = w[:nq]
+                split[base + "k_proj.weight"] = w[nq:nq + nkv]
+                split[base + "v_proj.weight"] = w[nq + nkv:nq + 2 * nkv]
+            elif k.endswith("mlp.gate_up_proj.weight"):
+                w = np.asarray(sd[k])
+                base = k[: -len("gate_up_proj.weight")]
+                split[base + "gate_proj.weight"] = w[:I]
+                split[base + "up_proj.weight"] = w[I:]
+        return super().convert_hf_state_dict(split, spec)
+
+
+def TpuPhi3ForCausalLM(model_path: str, config: InferenceConfig):
+    from ..application import CausalLMApplication
+    return CausalLMApplication(model_path, config, Phi3Family)

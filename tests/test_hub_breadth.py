@@ -1,0 +1,96 @@
+"""Golden tests for the second wave of decoder families: gemma2, phi3,
+granite, olmo2 (reference: contrib/models hub breadth — SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.family import get_family
+
+
+def _check(tmp_path, model_type, hf_model, atol=5e-3):
+    d = tmp_path / model_type
+    hf_model.eval()
+    hf_model.save_pretrained(d, safe_serialization=True)
+    family = get_family(model_type)
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    icfg = family.config_cls(tcfg, load_config=load_pretrained_config(str(d)))
+    app = CausalLMApplication(str(d), icfg, family)
+    app.load_weights().init_cache()
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 250, size=(2, 12), dtype=np.int64)
+    with torch.no_grad():
+        golden = hf_model(torch.tensor(ids)).logits.numpy()
+    out = app._run_prefill(ids.astype(np.int32), np.full((2,), 12, np.int32))
+    np.testing.assert_allclose(np.asarray(out["logits"]), golden,
+                               atol=atol, rtol=1e-3)
+    with torch.no_grad():
+        hf_seq = hf_model.generate(torch.tensor(ids), max_new_tokens=8,
+                                   do_sample=False).numpy()
+    app.reset()
+    res = app.generate(ids.astype(np.int32), max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+    return app
+
+
+def test_gemma2_matches_hf(tmp_path):
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Gemma2Config(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        vocab_size=256, rms_norm_eps=1e-5, max_position_embeddings=128,
+        query_pre_attn_scalar=16, sliding_window=8,
+        final_logit_softcapping=30.0, attn_logit_softcapping=50.0,
+        attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "gemma2", Gemma2ForCausalLM(cfg))
+    assert app.spec.layer_pattern == (True, False, True, False)
+    assert app.spec.attn_soft_cap == 50.0
+    assert app.spec.logits_soft_cap == 30.0
+
+
+def test_phi3_matches_hf(tmp_path):
+    from transformers import Phi3Config, Phi3ForCausalLM
+    torch.manual_seed(0)
+    cfg = Phi3Config(
+        hidden_size=64, intermediate_size=96, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        rms_norm_eps=1e-5, max_position_embeddings=128, pad_token_id=0,
+        attention_dropout=0.0, torch_dtype="float32")
+    _check(tmp_path, "phi3", Phi3ForCausalLM(cfg))
+
+
+def test_granite_matches_hf(tmp_path):
+    from transformers import GraniteConfig, GraniteForCausalLM
+    torch.manual_seed(0)
+    cfg = GraniteConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        rms_norm_eps=1e-5, max_position_embeddings=128,
+        embedding_multiplier=6.0, attention_multiplier=0.3,
+        residual_multiplier=0.5, logits_scaling=4.0,
+        tie_word_embeddings=False, torch_dtype="float32")
+    app = _check(tmp_path, "granite", GraniteForCausalLM(cfg))
+    assert app.spec.residual_multiplier == 0.5
+    assert app.spec.logits_divide == 4.0
+    assert app.spec.attn_scale == 0.3
+    assert app.spec.embed_scale == 6.0
+
+
+def test_olmo2_matches_hf(tmp_path):
+    from transformers import Olmo2Config, Olmo2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Olmo2Config(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        rms_norm_eps=1e-5, max_position_embeddings=128,
+        tie_word_embeddings=False, torch_dtype="float32")
+    app = _check(tmp_path, "olmo2", Olmo2ForCausalLM(cfg))
+    assert app.spec.norm_position == "post"
+    assert app.spec.qk_norm_full
