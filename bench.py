@@ -61,32 +61,60 @@ def main():
     res = app.generate(prompt, max_new_tokens=chunk + 1)
     compile_wall = time.perf_counter() - t0
 
-    # TTFT: prefill alone, post-compile
-    app.reset()
-    t0 = time.perf_counter()
-    out = app._run_prefill(prompt, np.full((batch,), prompt_len, np.int32))
-    jax.block_until_ready(out["tokens"])
-    ttft_ms = (time.perf_counter() - t0) * 1e3
-
-    # decode throughput: fused decode loop, several rounds
-    first = np.asarray(out["tokens"]).astype(np.int32)
-    positions = np.full((batch,), prompt_len, np.int32)
-    rounds, steps = 6, chunk
-    # one untimed round to settle
-    o = app._run_decode_loop(first, positions, steps)
-    jax.block_until_ready(o["tokens"])
-    positions = positions + steps
-    last = np.asarray(o["tokens"])[:, -1].astype(np.int32)
-    lat = []
-    for _ in range(rounds):
+    # Timing methodology: on remoted TPUs (axon tunnel) every device->host
+    # fetch costs a fixed network round trip (~70 ms here) and
+    # block_until_ready does not truly synchronize, so all timings use the
+    # SLOPE between two amortized runs of different lengths — the fixed
+    # fetch/dispatch latency cancels exactly. The tunnel RTT itself is
+    # measured and reported separately; a colocated host (the production
+    # topology) pays microseconds for the same fetch.
+    def fetch_floor():
         t0 = time.perf_counter()
-        o = app._run_decode_loop(last, positions, steps)
-        jax.block_until_ready(o["tokens"])
-        lat.append(time.perf_counter() - t0)
-        positions = positions + steps
-        last = np.asarray(o["tokens"])[:, -1].astype(np.int32)
-    total = sum(lat)
-    tok_s = batch * steps * rounds / total
+        np.asarray(app._run_decode(np.zeros((batch, 1), np.int32),
+                                   np.full((batch, 1), prompt_len + 1,
+                                           np.int32))["tokens"])
+        return (time.perf_counter() - t0) * 1e3
+
+    fetch_floor()
+    rtt_ms = min(fetch_floor() for _ in range(3))
+
+    # TTFT: n chained prefills (cache rows rotate through seq_ids), fetch
+    # once; slope over n cancels the fetch latency
+    def prefill_n(n):
+        app.reset()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = app._run_prefill(prompt, np.full((batch,), prompt_len,
+                                                   np.int32))
+        np.asarray(out["tokens"])
+        return time.perf_counter() - t0, out
+
+    prefill_n(1)                      # warm
+    t_a, _ = prefill_n(2)
+    t_b, out = prefill_n(10)
+    ttft_ms = (t_b - t_a) / 8 * 1e3
+    ttft_wall_ms = min(prefill_n(1)[0] for _ in range(2)) * 1e3
+
+    # decode throughput: fused decode loop, slope between two round counts
+    first = np.asarray(out["tokens"]).astype(np.int32)
+    steps = chunk
+
+    def decode_rounds(n):
+        positions = np.full((batch,), prompt_len, np.int32)
+        last = first
+        t0 = time.perf_counter()
+        for _ in range(n):
+            o = app._run_decode_loop(last, positions, steps)
+            last = o["tokens"][:, -1]          # stays on device
+            positions = positions + steps
+        np.asarray(o["tokens"])
+        return time.perf_counter() - t0
+
+    decode_rounds(1)                  # warm
+    t2 = min(decode_rounds(2) for _ in range(2))
+    t8 = min(decode_rounds(8) for _ in range(2))
+    per_step = (t8 - t2) / (6 * steps)
+    tok_s = batch / per_step
 
     # roofline: decode streams params + live KV once per step
     param_bytes = sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(app.params))
@@ -101,7 +129,9 @@ def main():
         "vs_baseline": round(tok_s / roofline, 4),
         "details": {
             "ttft_ms_prompt128": round(ttft_ms, 2),
-            "per_step_latency_ms": round(total / (rounds * steps) * 1e3, 3),
+            "ttft_wall_ms_incl_tunnel": round(ttft_wall_ms, 2),
+            "tunnel_rtt_ms": round(rtt_ms, 2),
+            "per_step_latency_ms": round(per_step * 1e3, 3),
             "compile_plus_first_gen_s": round(compile_wall, 1),
             "roofline_tok_s": round(roofline, 1),
             "param_bytes": param_bytes,

@@ -372,19 +372,27 @@ class CausalLMApplication:
                                 adapter_ids=adapter_ids,
                                 image_embeds=image_embeds,
                                 image_mask=padded_img_mask)
-        tokens = np.asarray(out["tokens"]).reshape(b, 1)
+        first = out["tokens"]                     # device array (B,)
+        try:
+            first.copy_to_host_async()
+        except AttributeError:
+            pass
         logits_trace = [np.asarray(out["logits"])] if return_logits and "logits" in out else []
-        ttft = time.perf_counter() - t0
 
         # eos_token_id: int or list of ints (HF allows multiple stop ids)
         eos_ids = (None if eos_token_id is None
                    else np.atleast_1d(np.asarray(eos_token_id, dtype=np.int64)))
-        collected = [tokens]
+        # tokens stay ON DEVICE through the loop — a device→host fetch costs a
+        # full tunnel round trip (~tens of ms on remoted TPUs), so EOS checks
+        # run one chunk late on an overlapped async copy instead of a
+        # synchronous fetch per step (reference async_execution.py hides the
+        # same latency with double-buffering).
+        collected = [first[:, None]]
+        pending = first[:, None]                  # device tokens not yet eos-checked
+        ttft = None
         positions = seq_lens.astype(np.int32)  # position of the token just sampled
         n_generated = 1
         eos_seen = np.zeros((b,), bool) if eos_ids is not None else None
-        if eos_seen is not None:
-            eos_seen |= np.isin(tokens[:, 0], eos_ids)
         chunk = max(self.tpu_config.decode_chunk_tokens, 1)
         while n_generated < max_new_tokens:
             remaining = max_new_tokens - n_generated
@@ -401,7 +409,7 @@ class CausalLMApplication:
                 o = self._run_decode(cur[:, None], positions[:, None],
                                      sampling_params=sampling_params,
                                      adapter_ids=adapter_ids)
-                new = np.asarray(o["tokens"]).reshape(b, 1)
+                new = o["tokens"].reshape(b, 1)
                 if return_logits and "logits" in o:
                     logits_trace.append(np.asarray(o["logits"]))
                 positions = positions + 1
@@ -410,15 +418,28 @@ class CausalLMApplication:
                 o = self._run_decode_loop(cur, positions, n,
                                           sampling_params=sampling_params,
                                           adapter_ids=adapter_ids)
-                new = np.asarray(o["tokens"])
+                new = o["tokens"]
                 positions = positions + n
                 n_generated += n
+            try:
+                new.copy_to_host_async()
+            except AttributeError:
+                pass
             collected.append(new)
+            if ttft is None:
+                # first token reached the host while the next chunk computes
+                np.asarray(first)
+                ttft = time.perf_counter() - t0
             if eos_seen is not None:
-                eos_seen |= np.isin(new, eos_ids).any(axis=1)
+                eos_seen |= np.isin(np.asarray(pending), eos_ids).any(axis=1)
+                pending = new
                 if eos_seen.all():
                     break
 
+        if ttft is None:
+            np.asarray(first)
+            ttft = time.perf_counter() - t0
+        collected = [np.asarray(c) for c in collected]
         result = _finalize_generation(input_ids, collected, eos_ids, ttft,
                                       seq_lens)
         if return_logits:

@@ -473,17 +473,21 @@ def attn_inputs(spec: DecoderSpec, position_ids, make_mask) -> Dict[str, Any]:
     return ai
 
 
-def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
+def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 ai, is_local, seq_ids, positions, phase: str,
                 identity_seq_ids: bool = False,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
                 adapter_ids=None, replace=None):
-    """One transformer layer. hidden (B,T,H); k/v_cache (B,S,Hkv,D) — or, in
-    the paged layout, (N_blocks, Bs, Hkv, D) with ``slot_mapping``/
-    ``block_table`` set (phase "paged", reference:
-    modules/kvcache/block_kv_cache_manager.py).
+    """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
+    cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
+    with ``slot_mapping``/``block_table`` set (phase "paged", reference:
+    modules/kvcache/block_kv_cache_manager.py). ``li``: this layer's index
+    into the cache (traced scalar). The cache flows through the layer scan
+    as CARRY with in-place scatters — writes cost O(tokens), not O(cache)
+    (the reference gets the same effect from buffer aliasing,
+    model_wrapper.py:1578-1627).
 
     ai: attn_inputs() bundle; is_local: this layer's local/global flag
     (traced scalar from the scan xs).
@@ -561,14 +565,18 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
 
     if phase == "paged":
         from ..modules import block_kv_cache as bkv
-        new_k = bkv.write_slots(
-            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale), slot_mapping)
-        new_v = bkv.write_slots(
-            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale), slot_mapping)
-        k_all = kv.dequantize_kv(bkv.gather_block_kv(new_k, block_table),
-                                 dtype, spec.kv_scale)
-        v_all = kv.dequantize_kv(bkv.gather_block_kv(new_v, block_table),
-                                 dtype, spec.kv_scale)
+        k_full = bkv.write_slots_at_layer(
+            k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale), li,
+            slot_mapping)
+        v_full = bkv.write_slots_at_layer(
+            v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale), li,
+            slot_mapping)
+        k_all = kv.dequantize_kv(
+            bkv.gather_block_kv(kv.read_layer(k_full, li), block_table),
+            dtype, spec.kv_scale)
+        v_all = kv.dequantize_kv(
+            bkv.gather_block_kv(kv.read_layer(v_full, li), block_table),
+            dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap, sink=sink)
     elif phase == "prefill":
@@ -591,26 +599,30 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap,
                                     sink=sink)
-        new_k = kv.write_prefill(
-            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale), seq_ids)
-        new_v = kv.write_prefill(
-            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale), seq_ids)
+        k_full = kv.write_prefill_at_layer(
+            k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
+            li, seq_ids)
+        v_full = kv.write_prefill_at_layer(
+            v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
+            li, seq_ids)
     else:
-        new_k = kv.write_tokens(
-            k_cache, kv.quantize_kv(k, k_cache.dtype, spec.kv_scale),
-            seq_ids, positions)
-        new_v = kv.write_tokens(
-            v_cache, kv.quantize_kv(v, v_cache.dtype, spec.kv_scale),
-            seq_ids, positions)
-        if identity_seq_ids and hidden.shape[0] == k_cache.shape[0]:
+        k_full = kv.write_tokens_at_layer(
+            k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
+            li, seq_ids, positions)
+        v_full = kv.write_tokens_at_layer(
+            v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
+            li, seq_ids, positions)
+        k_layer = kv.read_layer(k_full, li)
+        v_layer = kv.read_layer(v_full, li)
+        if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
             # static guarantee that seq_ids == arange (no continuous
             # batching): skip the row-gather copy of the whole cache
-            k_all = kv.dequantize_kv(new_k, dtype, spec.kv_scale)
-            v_all = kv.dequantize_kv(new_v, dtype, spec.kv_scale)
+            k_all = kv.dequantize_kv(k_layer, dtype, spec.kv_scale)
+            v_all = kv.dequantize_kv(v_layer, dtype, spec.kv_scale)
         else:
-            k_all = kv.dequantize_kv(kv.gather_cache_rows(new_k, seq_ids),
+            k_all = kv.dequantize_kv(kv.gather_cache_rows(k_layer, seq_ids),
                                      dtype, spec.kv_scale)
-            v_all = kv.dequantize_kv(kv.gather_cache_rows(new_v, seq_ids),
+            v_all = kv.dequantize_kv(kv.gather_cache_rows(v_layer, seq_ids),
                                      dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap, sink=sink)
@@ -647,7 +659,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_cache, v_cache,
     h = _tap("mlp_output", h)
     hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
     hidden = _tap("layer_output", hidden)
-    return hidden, new_k, new_v, caps
+    return hidden, k_full, v_full, caps
 
 
 def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
@@ -669,15 +681,16 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                            else (False,) * spec.num_layers)
     rep = replacements or {}
 
-    def make_body(mlp_kind):
+    def make_body(mlp_kind, offset):
         def body(carry, xs):
-            layer_w, kc, vc, loc, rp = xs
-            h, nk, nv, caps = _layer_body(
-                spec, carry, layer_w, kc, vc, ai, loc, seq_ids, positions,
-                phase, identity_seq_ids, arange_positions, slot_mapping,
-                block_table, mlp_kind, adapter_ids,
+            h, kf, vf = carry
+            layer_w, loc, rp, li = xs
+            h, kf, vf, caps = _layer_body(
+                spec, h, layer_w, kf, vf, li + offset, ai, loc, seq_ids,
+                positions, phase, identity_seq_ids, arange_positions,
+                slot_mapping, block_table, mlp_kind, adapter_ids,
                 rp if replacements is not None else None)
-            return h, (nk, nv, caps)
+            return (h, kf, vf), caps
         return body
 
     def sl(lo, hi):
@@ -685,25 +698,25 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
 
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
-        # MoE layers, two scans over one contiguous cache
+        # MoE layers, two scans carrying one contiguous cache
         nd = spec.first_dense
         L = spec.num_layers
-        hidden, (k1, v1, c1) = jax.lax.scan(
-            make_body("dense"), hidden,
-            (params["layers"], cache["k"][:nd], cache["v"][:nd],
-             is_local[:nd], sl(0, nd)))
-        hidden, (k2, v2, c2) = jax.lax.scan(
-            make_body("moe"), hidden,
-            (params["moe_layers"], cache["k"][nd:], cache["v"][nd:],
-             is_local[nd:], sl(nd, L)))
+        (hidden, kf, vf), c1 = jax.lax.scan(
+            make_body("dense", 0), (hidden, cache["k"], cache["v"]),
+            (params["layers"], is_local[:nd], sl(0, nd),
+             jnp.arange(nd, dtype=jnp.int32)))
+        (hidden, kf, vf), c2 = jax.lax.scan(
+            make_body("moe", nd), (hidden, kf, vf),
+            (params["moe_layers"], is_local[nd:], sl(nd, L),
+             jnp.arange(L - nd, dtype=jnp.int32)))
         caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
-        return hidden, {"k": jnp.concatenate([k1, k2]),
-                        "v": jnp.concatenate([v1, v2])}, caps
+        return hidden, {"k": kf, "v": vf}, caps
 
-    hidden, (new_k, new_v, caps) = jax.lax.scan(
-        make_body(None), hidden,
-        (params["layers"], cache["k"], cache["v"], is_local, rep))
-    return hidden, {"k": new_k, "v": new_v}, caps
+    L = spec.num_layers
+    (hidden, kf, vf), caps = jax.lax.scan(
+        make_body(None, 0), (hidden, cache["k"], cache["v"]),
+        (params["layers"], is_local, rep, jnp.arange(L, dtype=jnp.int32)))
+    return hidden, {"k": kf, "v": vf}, caps
 
 
 # ---------------------------------------------------------------------------

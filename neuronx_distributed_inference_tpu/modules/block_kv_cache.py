@@ -81,16 +81,25 @@ def write_slots(cache_layer: jnp.ndarray, new: jnp.ndarray,
     cache_layer (N, Bs, H, D); new (B, T, H, D); slot_mapping (B, T) flat slot
     ids (block*block_size + offset), negative = drop (padding).
     """
-    n, bs, h, d = cache_layer.shape
-    flat = cache_layer.reshape(n * bs, h, d)
+    return write_slots_at_layer(cache_layer[None], new, 0, slot_mapping)[0]
+
+
+def write_slots_at_layer(cache: jnp.ndarray, new: jnp.ndarray, layer,
+                         slot_mapping: jnp.ndarray) -> jnp.ndarray:
+    """In-place slot write into the FULL stacked cache (L, N, Bs, H, D) at
+    ``layer`` (traced scalar inside the layer scan) — see
+    kv_cache.write_tokens_at_layer for the carry-aliasing rationale."""
+    L, n, bs, h, d = cache.shape
+    flat = cache.reshape(L, n * bs, h, d)
     slots = slot_mapping.reshape(-1)
     # negative indices WRAP in jax scatter (slot -1 = last flat slot, which is
     # a real allocated block) — remap them past the end so mode="drop"
     # actually drops them
     slots = jnp.where(slots < 0, n * bs, slots)
-    vals = new.astype(cache_layer.dtype).reshape(-1, h, d)
-    flat = flat.at[slots].set(vals, mode="drop", unique_indices=False)
-    return flat.reshape(n, bs, h, d)
+    vals = new.astype(cache.dtype).reshape(-1, h, d)
+    li = jnp.asarray(layer, jnp.int32)
+    flat = flat.at[li, slots].set(vals, mode="drop", unique_indices=False)
+    return flat.reshape(L, n, bs, h, d)
 
 
 def gather_block_kv(cache_layer: jnp.ndarray, block_table: jnp.ndarray

@@ -129,12 +129,43 @@ def write_tokens(cache_layer: jnp.ndarray, new: jnp.ndarray,
     (reference: :605-606 uses % (w-1) to keep one slot for the active token;
     here the active token lives in the same cache so plain modulo is correct).
     """
+    return write_tokens_at_layer(cache_layer[None], new, 0, seq_ids,
+                                 positions, window)[0]
+
+
+def write_tokens_at_layer(cache: jnp.ndarray, new: jnp.ndarray, layer,
+                          seq_ids: jnp.ndarray, positions: jnp.ndarray,
+                          window: int = 0) -> jnp.ndarray:
+    """In-place token write into the FULL stacked cache (L, B, S, H, D) at
+    ``layer`` (a traced scalar inside the layer scan). Scattering into the
+    scan-carried full buffer — instead of rewriting a per-layer slice into
+    stacked scan outputs — keeps the decode-step HBM traffic at
+    read-cache + write-tokens rather than read-cache + write-cache
+    (the donated carry makes the scatter in-place)."""
     if window > 0:
         positions = positions % window
-    new = new.astype(cache_layer.dtype)
-    # out-of-range positions (padded requests write at pos >= S) are dropped
-    return cache_layer.at[seq_ids[:, None], positions].set(
+    new = new.astype(cache.dtype)
+    li = jnp.asarray(layer, jnp.int32)
+    return cache.at[li, seq_ids[:, None], positions].set(
         new, mode="drop", unique_indices=False)
+
+
+def write_prefill_at_layer(cache: jnp.ndarray, new: jnp.ndarray, layer,
+                           seq_ids: jnp.ndarray,
+                           start: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """Stacked-cache prefill write: the window goes to slots [start,
+    start+s) of rows ``seq_ids`` (start > 0 = chunked/windowed prefill at a
+    running offset)."""
+    s = new.shape[1]
+    pos = (jnp.arange(s, dtype=jnp.int32) + start)[None, :]
+    pos = jnp.broadcast_to(pos, (new.shape[0], s))
+    return write_tokens_at_layer(cache, new, layer, seq_ids, pos)
+
+
+def read_layer(cache: jnp.ndarray, layer) -> jnp.ndarray:
+    """Dynamic-slice one layer (B, S, H, D) out of the stacked cache."""
+    return jax.lax.dynamic_index_in_dim(cache, jnp.asarray(layer, jnp.int32),
+                                        0, keepdims=False)
 
 
 def gather_cache_rows(cache_layer: jnp.ndarray, seq_ids: jnp.ndarray) -> jnp.ndarray:

@@ -49,11 +49,13 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     b, t, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
-    qf = q.astype(jnp.float32).reshape(b, t, hkv, g, d)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
+    # QK^T on the MXU in the storage dtype (bf16 x bf16 -> fp32 accumulate);
+    # softmax math stays fp32. This avoids materializing an fp32 copy of the
+    # whole KV cache every decode step (the decode path is HBM-bound).
+    qk = q.reshape(b, t, hkv, g, d)
     # scores: (B, Hkv, G, T, S)
-    scores = jnp.einsum("bthgd,bshd->bhgts", qf, kf) * scale
+    scores = jnp.einsum("bthgd,bshd->bhgts", qk, k,
+                        preferred_element_type=jnp.float32) * scale
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:
@@ -71,9 +73,10 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         m = jnp.max(scores, axis=-1, keepdims=True)
         e = jnp.exp(scores - m)
         probs = e / jnp.sum(e, axis=-1, keepdims=True)
-    out = jnp.einsum("bhgts,bshd->bthgd", probs, vf)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
     # v head dim may differ from q/k head dim (MLA, deepseek)
-    return out.reshape(b, t, hq, vf.shape[-1]).astype(q.dtype)
+    return out.reshape(b, t, hq, v.shape[-1]).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

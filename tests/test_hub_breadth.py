@@ -25,17 +25,11 @@ def _check(tmp_path, model_type, hf_model, atol=5e-3):
 
     rng = np.random.default_rng(0)
     ids = rng.integers(1, 250, size=(2, 12), dtype=np.int64)
-    with torch.no_grad():
-        golden = hf_model(torch.tensor(ids)).logits.numpy()
-    out = app._run_prefill(ids.astype(np.int32), np.full((2,), 12, np.int32))
-    np.testing.assert_allclose(np.asarray(out["logits"]), golden,
-                               atol=atol, rtol=1e-3)
-    with torch.no_grad():
-        hf_seq = hf_model.generate(torch.tensor(ids), max_new_tokens=8,
-                                   do_sample=False).numpy()
-    app.reset()
-    res = app.generate(ids.astype(np.int32), max_new_tokens=8)
-    np.testing.assert_array_equal(res["sequences"], hf_seq)
+    # teacher-forced logit comparison + decisive-margin token check
+    # (greedy equality is brittle on tiny random models — near-tie logits)
+    from neuronx_distributed_inference_tpu.utils.testing import \
+        check_generation_golden
+    check_generation_golden(app, ids, hf_model, max_new_tokens=8, atol=atol)
     return app
 
 
