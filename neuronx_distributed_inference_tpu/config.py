@@ -287,6 +287,9 @@ class TpuConfig:
     qkv_kernel_enabled: bool = False
     mlp_kernel_enabled: bool = False
     attn_block_tkg_nki_kernel_enabled: bool = False
+    # Pallas fused decode attention (reference: attn_block_tkg NKI kernel
+    # family, models/config.py:417-567); None = auto (on where supported)
+    attn_block_tkg_kernel_enabled: Optional[bool] = None
 
     # --- async / host loop (reference: models/config.py:183) ---
     async_mode: bool = False

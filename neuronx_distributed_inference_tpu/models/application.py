@@ -245,7 +245,8 @@ class CausalLMApplication:
     def _run_prefill(self, input_ids: np.ndarray, seq_lens: np.ndarray,
                      seq_ids: Optional[np.ndarray] = None,
                      sampling_params=None, adapter_ids=None,
-                     image_embeds=None, image_mask=None):
+                     image_embeds=None, image_mask=None,
+                     rope_position_ids=None):
         b, s = input_ids.shape
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -261,16 +262,19 @@ class CausalLMApplication:
                                     weights=self.params)
         if image_mask is not None:
             image_mask = jnp.asarray(np.asarray(image_mask, bool))
+        if rope_position_ids is not None:
+            rope_position_ids = jnp.asarray(rope_position_ids)
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
                  jnp.asarray(seq_lens), sampling_params, self._next_rng(),
-                 adapter_ids, self.replacements, image_embeds, image_mask)
+                 adapter_ids, self.replacements, image_embeds, image_mask,
+                 rope_position_ids)
         self.cache = out["cache"]
         return out
 
     def _run_decode(self, input_ids: np.ndarray, position_ids: np.ndarray,
                     seq_ids: Optional[np.ndarray] = None, sampling_params=None,
-                    adapter_ids=None):
+                    adapter_ids=None, rope_position_ids=None):
         b = input_ids.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -288,26 +292,31 @@ class CausalLMApplication:
             self.snapshot.save_step({"input_ids": input_ids,
                                      "position_ids": position_ids,
                                      "seq_ids": seq_ids})
+        if rope_position_ids is not None:
+            rope_position_ids = jnp.asarray(rope_position_ids)
         out = fn(self.params, self.cache, jnp.asarray(input_ids),
                  jnp.asarray(position_ids), jnp.asarray(seq_ids),
                  sampling_params, self._next_rng(), adapter_ids,
-                 self.replacements)
+                 self.replacements, rope_position_ids)
         self.cache = out["cache"]
         return out
 
     def _run_decode_loop(self, first_tokens: np.ndarray, positions: np.ndarray,
                          num_steps: int, seq_ids: Optional[np.ndarray] = None,
-                         sampling_params=None, adapter_ids=None):
+                         sampling_params=None, adapter_ids=None,
+                         rope_position_ids=None):
         b = first_tokens.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
         fn = self.get_compiled("decode_loop", num_steps)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
+        if rope_position_ids is not None:
+            rope_position_ids = jnp.asarray(rope_position_ids)
         out = fn(self.params, self.cache, jnp.asarray(first_tokens),
                  jnp.asarray(positions), jnp.asarray(seq_ids), sampling_params,
                  self._next_rng(), num_steps=num_steps,
-                 adapter_ids=adapter_ids)
+                 adapter_ids=adapter_ids, rope_position_ids=rope_position_ids)
         self.cache = out["cache"]
         return out
 
@@ -324,7 +333,10 @@ class CausalLMApplication:
                  teacher_tokens: Optional[np.ndarray] = None,
                  adapter_ids: Optional[np.ndarray] = None,
                  image_embeds=None,
-                 image_mask: Optional[np.ndarray] = None) -> Dict[str, Any]:
+                 image_mask: Optional[np.ndarray] = None,
+                 rope_position_ids: Optional[np.ndarray] = None,
+                 decode_rope_start: Optional[np.ndarray] = None
+                 ) -> Dict[str, Any]:
         """Greedy/sampled generation. input_ids (B, S) right-padded;
         attention_mask (B, S) marks real tokens. Returns sequences including
         the prompt (HF convention).
@@ -333,7 +345,11 @@ class CausalLMApplication:
         feed these instead of the sampled tokens (reference:
         utils/accuracy.py logit flow re-feeds golden tokens).
         adapter_ids (B,): per-request LoRA adapter slot (multi-LoRA serving,
-        reference: modules/lora_serving/)."""
+        reference: modules/lora_serving/).
+        rope_position_ids (B, S, 3) + decode_rope_start (B, 3): M-RoPE
+        3-axis positions for the prompt and the first generated token
+        (qwen2-VL; reference: rotary_position_ids plumbing,
+        models/model_base.py:566-578). Decode advances all axes by 1/token."""
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
         if adapter_ids is not None:
@@ -361,6 +377,10 @@ class CausalLMApplication:
         if image_mask is not None:
             padded_img_mask = np.zeros((b, bucket), bool)
             padded_img_mask[:, :s] = np.asarray(image_mask, bool)
+        padded_rope = None
+        if rope_position_ids is not None:
+            padded_rope = np.zeros((b, bucket, 3), np.int32)
+            padded_rope[:, :s] = np.asarray(rope_position_ids, np.int32)
         max_total = int(seq_lens.max()) + max_new_tokens
         if max_total > self.tpu_config.seq_len:
             max_new_tokens = self.tpu_config.seq_len - int(seq_lens.max())
@@ -371,7 +391,8 @@ class CausalLMApplication:
         out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params,
                                 adapter_ids=adapter_ids,
                                 image_embeds=image_embeds,
-                                image_mask=padded_img_mask)
+                                image_mask=padded_img_mask,
+                                rope_position_ids=padded_rope)
         first = out["tokens"]                     # device array (B,)
         try:
             first.copy_to_host_async()
@@ -391,6 +412,8 @@ class CausalLMApplication:
         pending = first[:, None]                  # device tokens not yet eos-checked
         ttft = None
         positions = seq_lens.astype(np.int32)  # position of the token just sampled
+        rpos = (np.asarray(decode_rope_start, np.int32)
+                if decode_rope_start is not None else None)
         n_generated = 1
         eos_seen = np.zeros((b,), bool) if eos_ids is not None else None
         chunk = max(self.tpu_config.decode_chunk_tokens, 1)
@@ -406,20 +429,27 @@ class CausalLMApplication:
                                  dtype=np.int32)
                 n = 1
             if n == 1 or return_logits:
-                o = self._run_decode(cur[:, None], positions[:, None],
-                                     sampling_params=sampling_params,
-                                     adapter_ids=adapter_ids)
+                o = self._run_decode(
+                    cur[:, None], positions[:, None],
+                    sampling_params=sampling_params, adapter_ids=adapter_ids,
+                    rope_position_ids=(rpos[:, None, :] if rpos is not None
+                                       else None))
                 new = o["tokens"].reshape(b, 1)
                 if return_logits and "logits" in o:
                     logits_trace.append(np.asarray(o["logits"]))
                 positions = positions + 1
+                if rpos is not None:
+                    rpos = rpos + 1
                 n_generated += 1
             else:
                 o = self._run_decode_loop(cur, positions, n,
                                           sampling_params=sampling_params,
-                                          adapter_ids=adapter_ids)
+                                          adapter_ids=adapter_ids,
+                                          rope_position_ids=rpos)
                 new = o["tokens"]
                 positions = positions + n
+                if rpos is not None:
+                    rpos = rpos + n
                 n_generated += n
             try:
                 new.copy_to_host_async()

@@ -1,0 +1,3 @@
+from .modeling_mllama import (MllamaApplication, MllamaInferenceConfig,
+                              MllamaTextFamily, build_mllama_plan,
+                              compute_cross_kv)

@@ -1,0 +1,407 @@
+"""MLlama (Llama-3.2 Vision) family — cross-attention decoder + multimodal
+KV manager (reference: models/mllama/ — modeling_mllama.py cross-attention
+decoder layers, modules/kvcache/multimodal_kv_cache_manager.py,
+model_wrapper_mllama.py; 3380 LoC).
+
+TPU design:
+  * The text stack interleaves standard self-attention layers (the shared
+    DecoderSpec machinery, scanned per contiguous segment via
+    model_base.run_layer_slice) with tanh-gated cross-attention layers that
+    attend to vision states.
+  * Cross-attention K/V is the multimodal KV cache: computed ONCE per
+    request from the vision states (``compute_cross_kv``) and fed read-only
+    into every prefill/decode step — the analog of the reference's
+    MultimodalKVCacheManager holding cross-attention caches outside the
+    autoregressive cache.
+  * ``full_text_row_masked_out_mask`` semantics preserved: a text row whose
+    cross-attention mask is fully off attends uniformly (its additive mask
+    zeroes out) and its gated-MLP delta is suppressed
+    (HF _prepare_cross_attention_mask).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig, TpuConfig
+from ...modules.kv_cache import KVCacheSpec, init_cache
+from ...ops import attention as attn_ops
+from ...ops import sampling as sampling_ops
+from ...ops.normalization import rms_norm
+from ...parallel.layers import place_q_weight, replicate_kv_weight
+from ...utils import checkpoint as ckpt
+from ..family import DecoderFamily, register_family
+from ..model_base import (DecoderSpec, _embed, _lm_head, attn_inputs,
+                          run_layer_slice, spec_from_config)
+
+
+@dataclass(frozen=True)
+class MllamaSpec:
+    """Layer interleave plan: walk ``segments`` = [(n_self, has_cross), ...]
+    over the total stack (cross layer indices from HF
+    ``cross_attention_layers``)."""
+    segments: Tuple[Tuple[int, bool], ...]
+    num_self: int
+    num_cross: int
+
+
+def build_mllama_plan(total_layers: int, cross_layers: Tuple[int, ...]
+                      ) -> MllamaSpec:
+    cross = set(int(c) for c in cross_layers)
+    segments: List[Tuple[int, bool]] = []
+    run = 0
+    for i in range(total_layers):
+        if i in cross:
+            segments.append((run, True))
+            run = 0
+        else:
+            run += 1
+    if run:
+        segments.append((run, False))
+    return MllamaSpec(tuple(segments), total_layers - len(cross), len(cross))
+
+
+class MllamaTextConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size",
+                "cross_attention_layers"]
+
+
+@register_family("mllama_text")
+class MllamaTextFamily(DecoderFamily):
+    """Self-attention side of the stack (llama-shaped); cross layers are
+    converted separately by ``convert_cross_layers``."""
+    config_cls = MllamaTextConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        from ..model_base import pad_vocab
+        plan = build_mllama_plan(config.num_hidden_layers,
+                                 tuple(config.cross_attention_layers))
+        tcfg = config.tpu_config
+        tp = tp_degree if tp_degree is not None else tcfg.tp_degree
+        # HF mllama embeds vocab_size + 8 special image tokens; the embed
+        # table (and input ids) cover them while lm_head stays vocab_size
+        return spec_from_config(
+            config, tp_degree, num_layers=plan.num_self,
+            padded_vocab=pad_vocab(config.vocab_size + 8, tp))
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        # remap the non-contiguous self-layer indices onto 0..num_self-1,
+        # then run the standard llama conversion
+        cross = set()
+        i = 0
+        remapped = dict(sd)
+        # discover cross layers by key shape: cross layers have cross_attn.*
+        total = 0
+        for k in sd:
+            if ".layers." in k:
+                total = max(total, int(k.split(".layers.")[1].split(".")[0]) + 1)
+            if ".cross_attn." in k:
+                cross.add(int(k.split(".layers.")[1].split(".")[0]))
+        self_ids = [i for i in range(total) if i not in cross]
+        out = {}
+        for k, v in sd.items():
+            if ".layers." in k:
+                li = int(k.split(".layers.")[1].split(".")[0])
+                if li in cross:
+                    continue
+                k = k.replace(f".layers.{li}.",
+                              f".layers.{self_ids.index(li)}.")
+            out[k] = v
+        return super().convert_hf_state_dict(out, spec)
+
+
+def convert_cross_layers(sd: Dict[str, np.ndarray], spec: DecoderSpec,
+                         cross_ids: List[int], prefix: str = "model"
+                         ) -> Dict[str, np.ndarray]:
+    g, D = spec.gqa, spec.head_dim
+
+    def get(n):
+        return np.asarray(sd[n])
+
+    def q_t(w):
+        return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=-1)
+
+    def kv_t(w):
+        return replicate_kv_weight(np.ascontiguousarray(w.T), g, D, axis=-1)
+
+    def o_t(w):
+        return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=0)
+
+    def t(w):
+        return np.ascontiguousarray(w.T)
+
+    def stack(fmt, tr):
+        return np.stack([tr(get(fmt.format(i=i))) for i in cross_ids])
+
+    p = prefix
+    return {
+        "input_norm": stack(p + ".layers.{i}.input_layernorm.weight",
+                            np.asarray),
+        "q_proj": stack(p + ".layers.{i}.cross_attn.q_proj.weight", q_t),
+        "k_proj": stack(p + ".layers.{i}.cross_attn.k_proj.weight", kv_t),
+        "v_proj": stack(p + ".layers.{i}.cross_attn.v_proj.weight", kv_t),
+        "o_proj": stack(p + ".layers.{i}.cross_attn.o_proj.weight", o_t),
+        "q_norm": stack(p + ".layers.{i}.cross_attn.q_norm.weight",
+                        np.asarray),
+        "k_norm": stack(p + ".layers.{i}.cross_attn.k_norm.weight",
+                        np.asarray),
+        "attn_gate": stack(p + ".layers.{i}.cross_attn_attn_gate",
+                           np.asarray),
+        "mlp_gate": stack(p + ".layers.{i}.cross_attn_mlp_gate", np.asarray),
+        "post_norm": stack(p + ".layers.{i}.post_attention_layernorm.weight",
+                           np.asarray),
+        "gate_proj": stack(p + ".layers.{i}.mlp.gate_proj.weight", t),
+        "up_proj": stack(p + ".layers.{i}.mlp.up_proj.weight", t),
+        "down_proj": stack(p + ".layers.{i}.mlp.down_proj.weight", t),
+    }
+
+
+def compute_cross_kv(spec: DecoderSpec, cross_params, vision_states):
+    """The multimodal KV cache fill (reference:
+    multimodal_kv_cache_manager.py): per cross layer,
+    k = k_norm(k_proj(vision)), v = v_proj(vision).
+    vision_states (B, S_vis, H_text) -> k/v (Lc, B, S_vis, Hkv, D)."""
+    b, s, _ = vision_states.shape
+    g = spec.gqa
+
+    def one(lw):
+        k = (vision_states @ lw["k_proj"]).reshape(b, s, g.num_kv_heads,
+                                                   spec.head_dim)
+        k = rms_norm(k, lw["k_norm"], spec.rms_eps)
+        v = (vision_states @ lw["v_proj"]).reshape(b, s, g.num_kv_heads,
+                                                   spec.head_dim)
+        return k, v
+
+    ks, vs = jax.lax.map(one, cross_params)
+    return {"k": ks, "v": vs}
+
+
+def _cross_block(spec: DecoderSpec, hidden, lw, ck, cv, cross_mask):
+    """One tanh-gated cross-attention decoder layer (HF
+    MllamaCrossAttentionDecoderLayer semantics).
+
+    hidden (B, T, H); ck/cv (B, S_vis, Hkv, D); cross_mask (B, T, S_vis)
+    bool. HF row semantics (_prepare_cross_attention_mask): a text row whose
+    mask is fully off attends ALL keys uniformly (its additive mask zeroes
+    out), and only its gated-MLP delta is suppressed."""
+    b, t, _ = hidden.shape
+    g = spec.gqa
+    row_any = cross_mask.any(axis=-1, keepdims=True)        # (B, T, 1)
+    eff_mask = jnp.where(row_any, cross_mask, True)
+    r = rms_norm(hidden, lw["input_norm"], spec.rms_eps)
+    q = (r @ lw["q_proj"]).reshape(b, t, g.num_q_heads, spec.head_dim)
+    q = rms_norm(q, lw["q_norm"], spec.rms_eps)
+    a = attn_ops.mha(q, ck, cv, eff_mask, spec.scale)
+    a = a.reshape(b, t, -1) @ lw["o_proj"]
+    hidden = hidden + jnp.tanh(lw["attn_gate"]) * a
+    r = rms_norm(hidden, lw["post_norm"], spec.rms_eps)
+    m = (jax.nn.silu(r @ lw["gate_proj"]) * (r @ lw["up_proj"])) \
+        @ lw["down_proj"]
+    m = m * row_any.astype(m.dtype)
+    return hidden + jnp.tanh(lw["mlp_gate"]) * m
+
+
+def mllama_forward(spec: DecoderSpec, mspec: MllamaSpec, tcfg: TpuConfig,
+                   params, cache, cross_kv, input_ids, position_ids, seq_ids,
+                   seq_lens, cross_mask, sampling_params, rng,
+                   phase: str):
+    """One prefill or decode step through the interleaved stack.
+
+    phase "prefill": causal in-window self attention; cross_mask covers the
+    padded window. phase "decode": T=1 over the self cache."""
+    if phase == "prefill":
+        ai = attn_inputs(spec, position_ids,
+                         lambda w: attn_ops.prefill_causal_mask(
+                             input_ids.shape[1], position_ids, window=w))
+    else:
+        cache_len = cache["k"].shape[2]
+        ai = attn_inputs(spec, position_ids,
+                         lambda w: attn_ops.decode_mask(position_ids,
+                                                        cache_len, window=w))
+    hidden = _embed(spec, params, input_ids)
+    kf, vf = cache["k"], cache["v"]
+    si = ci = 0
+    empty_local = jnp.zeros((0,), bool)
+    for n_self, has_cross in mspec.segments:
+        if n_self:
+            seg = jax.tree.map(lambda a: a[si:si + n_self], params["layers"])
+            hidden, kf, vf, _ = run_layer_slice(
+                spec, seg, kf, vf, hidden, ai, cache_offset=si,
+                is_local=jnp.zeros((n_self,), bool), rep={}, mlp_kind=None,
+                seq_ids=seq_ids, positions=position_ids, phase=phase,
+                identity_seq_ids=not tcfg.is_continuous_batching,
+                arange_positions=(phase == "prefill"))
+            si += n_self
+        if has_cross:
+            lw = jax.tree.map(lambda a: a[ci], params["cross_layers"])
+            hidden = _cross_block(spec, hidden, lw, cross_kv["k"][ci],
+                                  cross_kv["v"][ci], cross_mask)
+            ci += 1
+    out: Dict[str, Any] = {"cache": {"k": kf, "v": vf}}
+    if phase == "prefill":
+        idx = jnp.maximum(seq_lens - 1, 0)
+        last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32),
+                                     axis=1)
+        logits = _lm_head(spec, params, last_h)[:, 0, :]
+        if tcfg.output_logits:
+            out["logits"] = _lm_head(spec, params,
+                                     hidden)[..., :spec.vocab_size]
+    else:
+        full = _lm_head(spec, params, hidden)
+        logits = full[:, -1, :]
+        if tcfg.output_logits:
+            out["logits"] = full[..., :spec.vocab_size]
+    out["tokens"] = sampling_ops.sample(
+        logits, tcfg.on_device_sampling_config, sampling_params, rng)
+    return out
+
+
+class MllamaInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "image_token_index"]
+
+
+class MllamaApplication:
+    """Cross-attention text application (reference: NeuronMllamaForCausalLM +
+    its dedicated ModelWrapper, model_wrapper_mllama.py). Vision states come
+    either from the vision tower or directly (``vision_states=`` argument —
+    the reference supports the same split via its two builders)."""
+
+    def __init__(self, model_path: Optional[str], config, mesh=None):
+        from ...parallel.mesh import mesh_from_config
+        self.config = config
+        self.tpu_config: TpuConfig = config.tpu_config
+        self.model_path = model_path
+        tc = dict(config.text_config) if hasattr(config, "text_config") \
+            else {}
+        self.text_config = MllamaTextConfig(self.tpu_config, **tc)
+        self.mesh = mesh or mesh_from_config(self.tpu_config)
+        mp = self.mesh.shape["tp"] * self.mesh.shape["ep"]
+        self.spec = MllamaTextFamily.build_spec(self.text_config, mp)
+        self.plan = build_mllama_plan(
+            self.text_config.num_hidden_layers,
+            tuple(self.text_config.cross_attention_layers))
+        self.params = None
+        self.cache = None
+        self._rng = jax.random.PRNGKey(self.tpu_config.seed)
+        self._cross_fn = jax.jit(partial(compute_cross_kv, self.spec))
+        self._steps: Dict[str, Any] = {}
+
+    def load_weights(self):
+        sd = ckpt.load_state_dict(self.model_path)
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+            for pre in ("model.language_model.", "language_model.model.",
+                        "language_model."):
+                if k.startswith(pre):
+                    text_sd["model." + k[len(pre):]] = v
+                    break
+            else:
+                if k.startswith("model.layers."):
+                    text_sd[k] = v
+                elif k.startswith("model.") and ".layers." not in k:
+                    text_sd[k] = v
+        host = MllamaTextFamily.convert_hf_state_dict(text_sd, self.spec)
+        cross_ids = sorted(
+            int(c) for c in self.text_config.cross_attention_layers)
+        host["cross_layers"] = convert_cross_layers(text_sd, self.spec,
+                                                    cross_ids)
+        self.params = jax.tree.map(jnp.asarray, host)
+        return self
+
+    def init_cache(self):
+        cfg = self.tpu_config
+        kvspec = KVCacheSpec(
+            num_layers=self.spec.num_layers, batch_size=cfg.kv_cache_batch_size,
+            max_seq_len=cfg.seq_len, num_kv_heads=self.spec.gqa.num_kv_heads,
+            head_dim=self.spec.head_dim, dtype=self.spec.kv_dtype)
+        self.cache = init_cache(kvspec, self.mesh)
+        return self
+
+    def _step(self, phase):
+        if phase not in self._steps:
+            self._steps[phase] = jax.jit(
+                partial(mllama_forward, self.spec, self.plan,
+                        self.tpu_config, phase=phase), donate_argnums=(1,))
+        return self._steps[phase]
+
+    def generate(self, input_ids: np.ndarray, vision_states: np.ndarray,
+                 cross_attention_mask: Optional[np.ndarray] = None,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 16,
+                 eos_token_id: Optional[int] = None) -> Dict[str, Any]:
+        """vision_states (B, S_vis, H_text): flattened projected vision
+        hidden states; cross_attention_mask (B, S_text, S_vis) bool (True =
+        attend) — defaults to all-on."""
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_lens = attention_mask.astype(np.int32).sum(axis=1)
+        if self.cache is None:
+            self.init_cache()
+        s_vis = vision_states.shape[1]
+        if cross_attention_mask is None:
+            cross_attention_mask = np.ones((b, s, s_vis), bool)
+        cross_kv = self._cross_fn(params_cross(self.params),
+                                  jnp.asarray(vision_states,
+                                              self.spec.dtype))
+
+        self._rng, k1 = jax.random.split(self._rng)
+        pos = np.broadcast_to(np.arange(s, dtype=np.int32), (b, s))
+        out = self._step("prefill")(
+            self.params, self.cache, cross_kv, jnp.asarray(input_ids),
+            jnp.asarray(pos), jnp.arange(b, dtype=jnp.int32),
+            jnp.asarray(seq_lens), jnp.asarray(cross_attention_mask),
+            None, k1)
+        self.cache = out["cache"]
+        tokens = [np.asarray(out["tokens"]).reshape(b, 1)]
+        logits = [np.asarray(out["logits"])] if "logits" in out else []
+
+        # decode: the new token reuses the LAST text row's cross mask (HF
+        # extends the mask with the final row during generation)
+        dec_mask = cross_attention_mask[:, -1:, :]
+        positions = seq_lens.astype(np.int32)
+        eos_ids = (None if eos_token_id is None
+                   else np.atleast_1d(np.asarray(eos_token_id)))
+        for _ in range(max_new_tokens - 1):
+            self._rng, k1 = jax.random.split(self._rng)
+            o = self._step("decode")(
+                self.params, self.cache, cross_kv,
+                jnp.asarray(tokens[-1][:, -1:].astype(np.int32)),
+                jnp.asarray(positions[:, None]),
+                jnp.arange(b, dtype=jnp.int32), None,
+                jnp.asarray(dec_mask), None, k1)
+            self.cache = o["cache"]
+            tokens.append(np.asarray(o["tokens"]).reshape(b, 1))
+            if "logits" in o:
+                logits.append(np.asarray(o["logits"]))
+            positions = positions + 1
+            if eos_ids is not None and np.isin(tokens[-1], eos_ids).all():
+                break
+        gen = np.concatenate(tokens, axis=1)
+        res = {"sequences": np.concatenate([input_ids, gen], axis=1),
+               "generated": gen}
+        if logits:
+            res["logits"] = logits
+        return res
+
+    def reset(self):
+        self.init_cache()
+        return self
+
+
+def params_cross(params):
+    return params["cross_layers"]

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import InferenceConfig, TpuConfig
 from ..ops import attention as attn_ops
+from ..ops import decode_attention
 from ..ops import flash_attention
 from ..ops import sampling as sampling_ops
 from ..ops.normalization import layer_norm, rms_norm
@@ -134,6 +135,9 @@ class DecoderSpec:
     # attention_base.py:90-96): True = use the Pallas flash kernel for
     # prefill when ops/flash_attention.supports() holds; XLA path otherwise
     flash_prefill: bool = False
+    # fused Pallas decode attention (reference analog: attention_block_tkg
+    # TKG kernel, attention_base.py:1186-1382); admission checked per-phase
+    decode_kernel: bool = False
     # MoE: when set, the MLP block is a routed mixture of experts
     # (reference: modules/moe_v2.py; intermediate_size then refers to the
     # per-expert intermediate)
@@ -452,7 +456,8 @@ def _mla_qkv(spec: DecoderSpec, h, layer_w, cos, sin):
     return q, k, v
 
 
-def attn_inputs(spec: DecoderSpec, position_ids, make_mask) -> Dict[str, Any]:
+def attn_inputs(spec: DecoderSpec, position_ids, make_mask,
+                rope_positions=None) -> Dict[str, Any]:
     """Bundle rope cos/sin + attention mask(s) for the layer stack.
 
     ``make_mask(window)`` builds the phase-appropriate mask. With a
@@ -461,13 +466,14 @@ def attn_inputs(spec: DecoderSpec, position_ids, make_mask) -> Dict[str, Any]:
     local_rope) and the global variant are built once here; each scanned
     layer selects by its is_local flag — one compiled layer body, no
     per-layer branching (SURVEY §2.7)."""
-    cos, sin = rope_cos_sin(position_ids, spec.rope)
+    rp = rope_positions if rope_positions is not None else position_ids
+    cos, sin = rope_cos_sin(rp, spec.rope)
     ai: Dict[str, Any] = {"cos": cos, "sin": sin}
     if spec.layer_pattern is None:
         ai["mask"] = make_mask(spec.sliding_window)
         return ai
     ai["mask"] = make_mask(0)
-    cos_l, sin_l = rope_cos_sin(position_ids, spec.local_rope or spec.rope)
+    cos_l, sin_l = rope_cos_sin(rp, spec.local_rope or spec.rope)
     ai["cos_l"], ai["sin_l"] = cos_l, sin_l
     ai["mask_l"] = make_mask(spec.sliding_window)
     return ai
@@ -612,20 +618,41 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         v_full = kv.write_tokens_at_layer(
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
             li, seq_ids, positions)
-        k_layer = kv.read_layer(k_full, li)
-        v_layer = kv.read_layer(v_full, li)
-        if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
-            # static guarantee that seq_ids == arange (no continuous
-            # batching): skip the row-gather copy of the whole cache
-            k_all = kv.dequantize_kv(k_layer, dtype, spec.kv_scale)
-            v_all = kv.dequantize_kv(v_layer, dtype, spec.kv_scale)
+        if (spec.decode_kernel and decode_attention.supports(spec, hidden.shape[1])
+                and identity_seq_ids and hidden.shape[0] == k_full.shape[1]
+                and spec.kv_scale is None and k_full.dtype == dtype
+                and spec.gqa.tp == 1 and not spec.flash_decoding):
+            # fused Pallas decode attention over the stacked cache: reads
+            # only the live prefix of each row (DMA block elision) and folds
+            # the active token in-registers — the cache row written above is
+            # masked out (kpos < pos), so write order is irrelevant
+            if spec.layer_pattern is not None:
+                win = jnp.where(is_local, spec.sliding_window, 0)
+            else:
+                win = jnp.asarray(spec.sliding_window, jnp.int32)
+            attn_out = decode_attention.decode_attention_stacked(
+                q[:, 0], k_full, v_full, k[:, 0], v[:, 0], li,
+                positions[:, 0], scale=spec.scale, window=win,
+                soft_cap=spec.attn_soft_cap, sink=sink,
+                interpret=jax.default_backend() != "tpu")[:, None]
         else:
-            k_all = kv.dequantize_kv(kv.gather_cache_rows(k_layer, seq_ids),
-                                     dtype, spec.kv_scale)
-            v_all = kv.dequantize_kv(kv.gather_cache_rows(v_layer, seq_ids),
-                                     dtype, spec.kv_scale)
-        attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
-                                logits_soft_cap=spec.attn_soft_cap, sink=sink)
+            k_layer = kv.read_layer(k_full, li)
+            v_layer = kv.read_layer(v_full, li)
+            if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
+                # static guarantee that seq_ids == arange (no continuous
+                # batching): skip the row-gather copy of the whole cache
+                k_all = kv.dequantize_kv(k_layer, dtype, spec.kv_scale)
+                v_all = kv.dequantize_kv(v_layer, dtype, spec.kv_scale)
+            else:
+                k_all = kv.dequantize_kv(
+                    kv.gather_cache_rows(k_layer, seq_ids), dtype,
+                    spec.kv_scale)
+                v_all = kv.dequantize_kv(
+                    kv.gather_cache_rows(v_layer, seq_ids), dtype,
+                    spec.kv_scale)
+            attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
+                                    logits_soft_cap=spec.attn_soft_cap,
+                                    sink=sink)
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
@@ -681,42 +708,63 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                            else (False,) * spec.num_layers)
     rep = replacements or {}
 
-    def make_body(mlp_kind, offset):
-        def body(carry, xs):
-            h, kf, vf = carry
-            layer_w, loc, rp, li = xs
-            h, kf, vf, caps = _layer_body(
-                spec, h, layer_w, kf, vf, li + offset, ai, loc, seq_ids,
-                positions, phase, identity_seq_ids, arange_positions,
-                slot_mapping, block_table, mlp_kind, adapter_ids,
-                rp if replacements is not None else None)
-            return (h, kf, vf), caps
-        return body
-
     def sl(lo, hi):
         return jax.tree.map(lambda a: a[lo:hi], rep)
 
+    kw = dict(seq_ids=seq_ids, positions=positions, phase=phase,
+              identity_seq_ids=identity_seq_ids,
+              arange_positions=arange_positions, slot_mapping=slot_mapping,
+              block_table=block_table, adapter_ids=adapter_ids,
+              replacements=replacements)
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans carrying one contiguous cache
         nd = spec.first_dense
         L = spec.num_layers
-        (hidden, kf, vf), c1 = jax.lax.scan(
-            make_body("dense", 0), (hidden, cache["k"], cache["v"]),
-            (params["layers"], is_local[:nd], sl(0, nd),
-             jnp.arange(nd, dtype=jnp.int32)))
-        (hidden, kf, vf), c2 = jax.lax.scan(
-            make_body("moe", nd), (hidden, kf, vf),
-            (params["moe_layers"], is_local[nd:], sl(nd, L),
-             jnp.arange(L - nd, dtype=jnp.int32)))
+        hidden, kf, vf, c1 = run_layer_slice(
+            spec, params["layers"], cache["k"], cache["v"], hidden, ai,
+            cache_offset=0, is_local=is_local[:nd], rep=sl(0, nd),
+            mlp_kind="dense", **kw)
+        hidden, kf, vf, c2 = run_layer_slice(
+            spec, params["moe_layers"], kf, vf, hidden, ai,
+            cache_offset=nd, is_local=is_local[nd:], rep=sl(nd, L),
+            mlp_kind="moe", **kw)
         caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
         return hidden, {"k": kf, "v": vf}, caps
 
     L = spec.num_layers
-    (hidden, kf, vf), caps = jax.lax.scan(
-        make_body(None, 0), (hidden, cache["k"], cache["v"]),
-        (params["layers"], is_local, rep, jnp.arange(L, dtype=jnp.int32)))
+    hidden, kf, vf, caps = run_layer_slice(
+        spec, params["layers"], cache["k"], cache["v"], hidden, ai,
+        cache_offset=0, is_local=is_local, rep=rep, mlp_kind=None, **kw)
     return hidden, {"k": kf, "v": vf}, caps
+
+
+def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
+                    cache_offset: int, is_local, rep, mlp_kind,
+                    seq_ids, positions, phase,
+                    identity_seq_ids=False, arange_positions=False,
+                    slot_mapping=None, block_table=None, adapter_ids=None,
+                    replacements=None):
+    """Scan one contiguous run of stacked layers against the full cache
+    (cache layer index = scan index + ``cache_offset``). Exposed so families
+    with interleaved non-standard layers (mllama cross-attention decoder)
+    can stitch standard segments around their own blocks."""
+    n = jax.tree.leaves(layer_params)[0].shape[0]
+
+    def body(carry, xs):
+        h, k_, v_ = carry
+        layer_w, loc, rp, li = xs
+        h, k_, v_, caps = _layer_body(
+            spec, h, layer_w, k_, v_, li + cache_offset, ai, loc, seq_ids,
+            positions, phase, identity_seq_ids, arange_positions,
+            slot_mapping, block_table, mlp_kind, adapter_ids,
+            rp if replacements is not None else None)
+        return (h, k_, v_), caps
+
+    (hidden, kf, vf), caps = jax.lax.scan(
+        body, (hidden, kf, vf),
+        (layer_params, is_local, rep, jnp.arange(n, dtype=jnp.int32)))
+    return hidden, kf, vf, caps
 
 
 # ---------------------------------------------------------------------------
@@ -746,7 +794,7 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids, seq_lens,
                           sampling_params, rng, adapter_ids=None,
                           replacements=None, image_embeds=None,
-                          image_mask=None):
+                          image_mask=None, rope_position_ids=None):
     """Prefill graph (reference submodel tag ``context_encoding_model``).
 
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
@@ -758,7 +806,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     Returns dict(tokens (B,), last_logits (B, V) [optional], cache).
     """
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.prefill_causal_mask(
-        input_ids.shape[1], position_ids, window=w))
+        input_ids.shape[1], position_ids, window=w),
+        rope_positions=rope_position_ids)
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
     hidden = _embed(spec, params, input_ids)
@@ -802,14 +851,18 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids,
                           sampling_params, rng, adapter_ids=None,
-                          replacements=None):
+                          replacements=None, rope_position_ids=None):
     """Decode graph (reference submodel tag ``token_generation_model``).
 
     input_ids (B, T) with T = 1 (or speculation window).
+    rope_position_ids (B, T, 3): optional M-RoPE 3-axis positions
+    (reference: qwen2_vl rotary_position_ids plumbing,
+    models/model_base.py:566-578).
     """
     cache_len = cache["k"].shape[2]
     ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
-        position_ids, cache_len, window=w))
+        position_ids, cache_len, window=w),
+        rope_positions=rope_position_ids)
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
@@ -882,7 +935,7 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                 first_tokens, position_ids, seq_ids, sampling_params, rng,
-                num_steps: int, adapter_ids=None):
+                num_steps: int, adapter_ids=None, rope_position_ids=None):
     """Fused multi-token decode: ``lax.scan`` of ``num_steps`` decode steps in
     ONE device call. This is the TPU answer to the reference's async
     double-buffering (modules/async_execution.py) — instead of hiding the
@@ -893,18 +946,26 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     Returns (tokens (B, num_steps), cache).
     """
 
+    use_mrope = rope_position_ids is not None
+
     def step(carry, step_rng):
-        tok, pos, cch = carry
+        tok, pos, rpos, cch = carry
         out = token_generation_step(
             spec, replace_output_logits(tpu_cfg), params, cch,
             tok[:, None], pos[:, None], seq_ids, sampling_params, step_rng,
-            adapter_ids)
+            adapter_ids,
+            rope_position_ids=rpos[:, None, :] if use_mrope else None)
         nxt = out["tokens"]
-        return (nxt, pos + 1, out["cache"]), nxt
+        # text-token M-RoPE positions advance in lockstep on all 3 axes
+        return (nxt, pos + 1, rpos + 1 if use_mrope else rpos,
+                out["cache"]), nxt
 
+    if rope_position_ids is None:
+        rope_position_ids = jnp.zeros(
+            (first_tokens.shape[0], 3), position_ids.dtype)
     rngs = jax.random.split(rng, num_steps)
-    (_, _, new_cache), toks = jax.lax.scan(
-        step, (first_tokens, position_ids, cache), rngs)
+    (_, _, _, new_cache), toks = jax.lax.scan(
+        step, (first_tokens, position_ids, rope_position_ids, cache), rngs)
     return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
 
 
@@ -935,6 +996,13 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     gqa = resolve_gqa_sharding(n_q, n_kv, tp)
     rope_scaling = getattr(config, "rope_scaling", None) or {}
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+    # "default"/"mrope" are not frequency-scaling schemes: default = plain
+    # rope; mrope = 3-axis multimodal sections (qwen2-VL)
+    mrope_section = None
+    if rope_type in ("default", "mrope"):
+        if "mrope_section" in rope_scaling:
+            mrope_section = tuple(int(x) for x in rope_scaling["mrope_section"])
+        rope_type = None
     attention_factor = rope_scaling.get("attention_factor")
     rope = RopeConfig(
         head_dim=head_dim,
@@ -954,6 +1022,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         attention_factor=(float(attention_factor)
                           if attention_factor is not None else None),
         truncate=bool(rope_scaling.get("truncate", True)),
+        mrope_section=mrope_section,
     )
     vocab = config.vocab_size
     kw = dict(
@@ -979,6 +1048,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         # attn_kernel_enabled until it beats XLA (reference keeps the same
         # dual-path structure, attention_base.py:985-1034)
         flash_prefill=bool(tcfg.attn_kernel_enabled),
+        decode_kernel=bool(tcfg.attn_block_tkg_kernel_enabled),
         quant=quant_spec_from_config(tcfg),
         lora=lora_spec_from_config(tcfg),
         seq_parallel=bool(tcfg.sequence_parallel_enabled),

@@ -1,0 +1,245 @@
+"""Pixtral family — llava-composed mistral text + Pixtral ViT
+(reference: models/pixtral/ — modeling_pixtral_vision.py RMSNorm tower with
+2-D rope + gated MLP, modeling_pixtral.py llava-style merge; 1109 LoC).
+
+The text side is the registered mistral family (ImageToTextInferenceConfig
+routes by text_config.model_type); this module adds the Pixtral vision
+tower: patch conv -> RMSNorm pre-norm -> layers of (RMSNorm, rope'd
+bidirectional attention, gated silu MLP, RMSNorm) -> llava projector.
+Rope angles come from the (h*max_w + w)-indexed frequency table
+(interleaved h/w frequency slots — HF PixtralRotaryEmbedding semantics)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.normalization import rms_norm
+from ..image_to_text import ImageToTextApplication, ImageToTextInferenceConfig
+
+
+@dataclass(frozen=True)
+class PixtralVisionSpec:
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    image_size: int
+    rope_theta: float = 10000.0
+    eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def max_side(self) -> int:
+        return self.image_size // self.patch_size
+
+
+def pixtral_vision_spec(vc: Dict[str, Any]) -> PixtralVisionSpec:
+    return PixtralVisionSpec(
+        num_layers=int(vc["num_hidden_layers"]),
+        hidden_size=int(vc["hidden_size"]),
+        num_heads=int(vc["num_attention_heads"]),
+        intermediate_size=int(vc["intermediate_size"]),
+        patch_size=int(vc["patch_size"]),
+        image_size=int(vc["image_size"]),
+        rope_theta=float(vc.get("rope_theta", 10000.0)),
+    )
+
+
+def pixtral_rope_table(spec: PixtralVisionSpec) -> np.ndarray:
+    """(max_side^2, head_dim/2) angle table; row h*max_w + w holds the
+    interleaved h/w frequencies (HF PixtralRotaryEmbedding)."""
+    d = spec.head_dim
+    freqs = 1.0 / (spec.rope_theta
+                   ** (np.arange(0, d, 2, dtype=np.float32) / d))
+    side = spec.max_side
+    h = np.arange(side, dtype=np.float32)
+    fh = np.outer(h, freqs[0::2])                       # (side, d/4)
+    fw = np.outer(h, freqs[1::2])
+    table = np.concatenate([
+        np.repeat(fh[:, None, :], side, axis=1),
+        np.repeat(fw[None, :, :], side, axis=0)], axis=-1)
+    return table.reshape(side * side, d // 2).astype(np.float32)
+
+
+def pixtral_vision_forward(spec: PixtralVisionSpec, params, pixel_values,
+                           cos, sin, block_mask):
+    """pixel_values (B, C, H, W) same-size images; cos/sin (N, head_dim/2)
+    rope angles for the flattened patch sequence of ONE image (tiled by the
+    caller for B > 1 after flattening); block_mask (N, N) attend-within-image.
+    Returns (B, patches_per_image, hidden)."""
+    b, c, hh, ww = pixel_values.shape
+    p = spec.patch_size
+    gh, gw = hh // p, ww // p
+    nh, hd = spec.num_heads, spec.head_dim
+    # patch conv (stride == kernel) == linear over the flat patch
+    x = pixel_values.reshape(b, c, gh, p, gw, p).transpose(0, 2, 4, 1, 3, 5)
+    x = x.reshape(b, gh * gw, c * p * p) @ params["patch_proj"]
+    x = rms_norm(x, params["ln_pre"], spec.eps)
+    n = gh * gw
+
+    def rope(t):                                       # (B, N, nh, hd)
+        tf = t.astype(jnp.float32)
+        d2 = cos.shape[-1]
+        t1, t2 = tf[..., :d2], tf[..., d2:]
+        cc, ss = cos[None, :, None, :], sin[None, :, None, :]
+        return jnp.concatenate([t1 * cc - t2 * ss, t2 * cc + t1 * ss],
+                               axis=-1).astype(t.dtype)
+
+    def body(h, lw):
+        r = rms_norm(h, lw["attn_norm"], spec.eps)
+        q = rope((r @ lw["q"]).reshape(b, n, nh, hd))
+        k = rope((r @ lw["k"]).reshape(b, n, nh, hd))
+        v = (r @ lw["v"]).reshape(b, n, nh, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        s = jnp.where(block_mask[None, None], s, -1e30)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhqk,bkhd->bqhd", pr, v.astype(jnp.float32))
+        h = h + a.reshape(b, n, -1).astype(h.dtype) @ lw["o"]
+        r = rms_norm(h, lw["ffn_norm"], spec.eps)
+        h = h + (jax.nn.silu(r @ lw["gate"]) * (r @ lw["up"])) @ lw["down"]
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def convert_pixtral_tower(sd: Dict[str, np.ndarray], spec: PixtralVisionSpec,
+                          prefix: str) -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"transformer.layers.{i}"
+        return {
+            "attn_norm": get(f"{b}.attention_norm.weight"),
+            "q": t(get(f"{b}.attention.q_proj.weight")),
+            "k": t(get(f"{b}.attention.k_proj.weight")),
+            "v": t(get(f"{b}.attention.v_proj.weight")),
+            "o": t(get(f"{b}.attention.o_proj.weight")),
+            "ffn_norm": get(f"{b}.ffn_norm.weight"),
+            "gate": t(get(f"{b}.feed_forward.gate_proj.weight")),
+            "up": t(get(f"{b}.feed_forward.up_proj.weight")),
+            "down": t(get(f"{b}.feed_forward.down_proj.weight")),
+        }
+
+    layers = [lw(i) for i in range(spec.num_layers)]
+    return {
+        "patch_proj": t(get("patch_conv.weight").reshape(
+            spec.hidden_size, -1)),
+        "ln_pre": get("ln_pre.weight"),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+    }
+
+
+class PixtralInferenceConfig(ImageToTextInferenceConfig):
+    pass
+
+
+class PixtralApplication(ImageToTextApplication):
+    """Pixtral tower + mistral text (reference: models/pixtral/)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: PixtralInferenceConfig, mesh=None):
+        # no super().__init__: the parent builds a CLIP tower; here only the
+        # text app + projector plumbing are shared
+        from ..application import CausalLMApplication
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        mesh=mesh)
+        self.image_token_index = int(config.image_token_index)
+        self.vision_params = None
+        self.projector = None
+        self._project = jax.jit(self._project_fn)
+        self.pix_spec = pixtral_vision_spec(dict(config.vision_config))
+        self._pix_fn = jax.jit(partial(pixtral_vision_forward, self.pix_spec))
+        self._rope_table = pixtral_rope_table(self.pix_spec)
+        self._image_hw = None   # (H, W) of the images served, set at encode
+
+    def load_weights(self, model_path: Optional[str] = None):
+        from ...utils import checkpoint as ckpt
+        path = model_path or self.model_path
+        sd = ckpt.load_state_dict(path)
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+                continue
+            for pre, new in (("model.language_model.", "model."),
+                             ("language_model.model.", "model."),
+                             ("language_model.", "model.")):
+                if k.startswith(pre):
+                    text_sd[new + k[len(pre):]] = v
+                    break
+        host = self.text.family.convert_hf_state_dict(text_sd, self.text.spec)
+        self.text._put_params(host)
+        vis_prefix = ("model.vision_tower" if any(
+            k.startswith("model.vision_tower") for k in sd)
+            else "vision_tower")
+        self.vision_params = jax.tree.map(
+            jnp.asarray, convert_pixtral_tower(sd, self.pix_spec, vis_prefix))
+        proj_prefix = ("model.multi_modal_projector" if any(
+            k.startswith("model.multi_modal_projector") for k in sd)
+            else "multi_modal_projector")
+
+        def t(w):
+            return jnp.asarray(np.ascontiguousarray(
+                np.asarray(w, np.float32).T))
+
+        self.projector = {
+            "w1": t(sd[f"{proj_prefix}.linear_1.weight"]),
+            "w2": t(sd[f"{proj_prefix}.linear_2.weight"]),
+        }
+        for nm, key in (("linear_1.bias", "b1"), ("linear_2.bias", "b2")):
+            full = f"{proj_prefix}.{nm}"
+            if full in sd:
+                self.projector[key] = jnp.asarray(
+                    np.asarray(sd[full], np.float32))
+        return self
+
+    def _project_fn(self, projector, feats):
+        h = feats @ projector["w1"]
+        if "b1" in projector:
+            h = h + projector["b1"]
+        h = jax.nn.gelu(h, approximate=False)
+        h = h @ projector["w2"]
+        if "b2" in projector:
+            h = h + projector["b2"]
+        return h
+
+    def encode_images(self, pixel_values: np.ndarray) -> jnp.ndarray:
+        pv = np.asarray(pixel_values, np.float32)
+        b, c, hh, ww = pv.shape
+        p = self.pix_spec.patch_size
+        gh, gw = hh // p, ww // p
+        # rope angles for this grid via the (h*max_w + w) table
+        pos = (np.arange(gh)[:, None] * self.pix_spec.max_side
+               + np.arange(gw)[None, :]).ravel()
+        ang = self._rope_table[pos]
+        mask = np.ones((gh * gw, gh * gw), bool)   # one image per row: full
+        feats = self._pix_fn(self.vision_params, jnp.asarray(pv),
+                             jnp.asarray(np.cos(ang)),
+                             jnp.asarray(np.sin(ang)), jnp.asarray(mask))
+        self._image_hw = (gh, gw)
+        return self._project(self.projector, feats)
+
+    @property
+    def tokens_per_image(self) -> int:
+        if self._image_hw is None:
+            raise RuntimeError("encode_images first")
+        return self._image_hw[0] * self._image_hw[1]

@@ -1,0 +1,2 @@
+from .modeling_qwen2_vl import (Qwen2VLApplication, Qwen2VLInferenceConfig,
+                                Qwen2VLTextFamily)

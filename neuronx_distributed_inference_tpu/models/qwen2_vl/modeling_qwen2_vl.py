@@ -1,0 +1,348 @@
+"""Qwen2-VL family — M-RoPE text decoder + windowless ViT vision tower
+(reference: models/qwen2_vl/ — modeling_qwen2_vl_text.py M-RoPE attention
+:52-136, modeling_qwen2_vl_vision.py vision tower, rotary_position_ids
+plumbing models/model_base.py:566-578; 1350 LoC).
+
+TPU design:
+  * Text side: the standard qwen2 DecoderSpec with ``rope.mrope_section``
+    set; 3-axis rope positions flow through the ``rope_position_ids``
+    graph input (ops/rope.py M-RoPE slot selection).
+  * Vision side: a functional patch-transformer — patch-linear embed
+    (= HF's stride-equal Conv3d), 2-D rotary over (h, w) patch coordinates,
+    full bidirectional attention per image (block mask from patch→image
+    ids), and the 2x2 spatial PatchMerger. Runs as one jitted call over all
+    images' patches.
+  * get_rope_index (host): faithful numpy port of the HF 3-axis position
+    walk for image inputs.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig, TpuConfig
+from ...ops.normalization import layer_norm
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+from ..qwen2.modeling_qwen2 import Qwen2Family, Qwen2InferenceConfig
+
+
+# ---------------------------------------------------------------------------
+# Vision tower
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Qwen2VLVisionSpec:
+    depth: int
+    embed_dim: int
+    num_heads: int
+    mlp_hidden: int
+    patch_input: int          # in_channels * temporal_patch * patch * patch
+    spatial_merge: int
+    out_hidden: int
+    act: str = "quick_gelu"
+    eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def vision_spec_from_hf(vc: Dict[str, Any]) -> Qwen2VLVisionSpec:
+    embed = int(vc.get("embed_dim", vc.get("hidden_size")))
+    return Qwen2VLVisionSpec(
+        depth=int(vc["depth"]),
+        embed_dim=embed,
+        num_heads=int(vc["num_heads"]),
+        mlp_hidden=int(embed * float(vc.get("mlp_ratio", 4.0))),
+        patch_input=(int(vc.get("in_channels", vc.get("in_chans", 3)))
+                     * int(vc.get("temporal_patch_size", 2))
+                     * int(vc["patch_size"]) ** 2),
+        spatial_merge=int(vc.get("spatial_merge_size", 2)),
+        out_hidden=int(vc["hidden_size"]),
+        act=str(vc.get("hidden_act", "quick_gelu")),
+    )
+
+
+_V_ACTS = {
+    "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "silu": jax.nn.silu,
+}
+
+
+def vision_forward(spec: Qwen2VLVisionSpec, params: Dict[str, Any],
+                   patches: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray,
+                   image_ids: jnp.ndarray) -> jnp.ndarray:
+    """patches (N, patch_input); cos/sin (N, head_dim/2) precomputed 2-D
+    rotary angles; image_ids (N,) patch->image id (attention stays within an
+    image — HF's cu_seqlens block mask). Returns merged features
+    (N / merge^2, out_hidden)."""
+    n = patches.shape[0]
+    nh, hd = spec.num_heads, spec.head_dim
+    act = _V_ACTS[spec.act]
+    x = patches @ params["patch_proj"]                      # (N, E)
+    block_mask = (image_ids[:, None] == image_ids[None, :])  # (N, N)
+
+    def rope2d(t):                                          # t (N, nh, hd)
+        tf = t.astype(jnp.float32)
+        d2 = cos.shape[-1]
+        t1, t2 = tf[..., :d2], tf[..., d2:]
+        c, s = cos[:, None, :], sin[:, None, :]
+        return jnp.concatenate([t1 * c - t2 * s, t2 * c + t1 * s],
+                               axis=-1).astype(t.dtype)
+
+    def body(h, lw):
+        r = layer_norm(h, lw["ln1_w"], lw["ln1_b"], spec.eps)
+        qkv = r @ lw["qkv_w"] + lw["qkv_b"]                 # (N, 3E)
+        q, k, v = jnp.split(qkv.reshape(n, 3, nh, hd), 3, axis=1)
+        q = rope2d(q[:, 0])
+        k = rope2d(k[:, 0])
+        v = v[:, 0]
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        s = jnp.where(block_mask[None], s, -1e30)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
+        h = h + (a.reshape(n, -1).astype(h.dtype) @ lw["proj_w"]
+                 + lw["proj_b"])
+        r = layer_norm(h, lw["ln2_w"], lw["ln2_b"], spec.eps)
+        m = act(r @ lw["fc1_w"] + lw["fc1_b"])
+        h = h + m @ lw["fc2_w"] + lw["fc2_b"]
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    # PatchMerger: LN then group merge^2 spatially-adjacent patches (the
+    # rot_pos_emb permutation makes them contiguous) through a 2-layer MLP
+    x = layer_norm(x, params["ln_q_w"], params["ln_q_b"], spec.eps)
+    x = x.reshape(n // spec.spatial_merge ** 2, -1)
+    x = jax.nn.gelu(x @ params["mlp0_w"] + params["mlp0_b"],
+                    approximate=False)
+    return x @ params["mlp2_w"] + params["mlp2_b"]
+
+
+def convert_vision_tower(sd: Dict[str, np.ndarray], spec: Qwen2VLVisionSpec,
+                         prefix: str = "visual") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"blocks.{i}"
+        return {
+            "ln1_w": get(f"{b}.norm1.weight"), "ln1_b": get(f"{b}.norm1.bias"),
+            "qkv_w": t(get(f"{b}.attn.qkv.weight")),
+            "qkv_b": get(f"{b}.attn.qkv.bias"),
+            "proj_w": t(get(f"{b}.attn.proj.weight")),
+            "proj_b": get(f"{b}.attn.proj.bias"),
+            "ln2_w": get(f"{b}.norm2.weight"), "ln2_b": get(f"{b}.norm2.bias"),
+            "fc1_w": t(get(f"{b}.mlp.fc1.weight")),
+            "fc1_b": get(f"{b}.mlp.fc1.bias"),
+            "fc2_w": t(get(f"{b}.mlp.fc2.weight")),
+            "fc2_b": get(f"{b}.mlp.fc2.bias"),
+        }
+
+    layers = [lw(i) for i in range(spec.depth)]
+    return {
+        # Conv3d with stride == kernel == one flat linear over the patch
+        "patch_proj": t(get("patch_embed.proj.weight").reshape(
+            spec.embed_dim, -1)),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+        "ln_q_w": get("merger.ln_q.weight"), "ln_q_b": get("merger.ln_q.bias"),
+        "mlp0_w": t(get("merger.mlp.0.weight")),
+        "mlp0_b": get("merger.mlp.0.bias"),
+        "mlp2_w": t(get("merger.mlp.2.weight")),
+        "mlp2_b": get("merger.mlp.2.bias"),
+    }
+
+
+def vision_rot_angles(grid_thw: np.ndarray, spec: Qwen2VLVisionSpec
+                      ) -> np.ndarray:
+    """Per-patch (h, w) rotary angles in HF's merge-group-permuted patch
+    order (reference: modeling_qwen2_vl_vision.py ``rot_pos_emb``).
+    Returns (N, head_dim/2) fp32 angles (first half h-freqs, second half w)."""
+    m = spec.spatial_merge
+    dim = spec.head_dim // 2          # rotary dim (h + w halves)
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float32) / dim))
+    out = []
+    for t, h, w in np.asarray(grid_thw):
+        hp = np.arange(h)[:, None] * np.ones((1, w), np.int64)
+        wp = np.ones((h, 1), np.int64) * np.arange(w)[None, :]
+
+        def perm(x):
+            return x.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).ravel()
+
+        hh, ww = perm(hp), perm(wp)
+        ang = np.concatenate([hh[:, None] * inv[None, :],
+                              ww[:, None] * inv[None, :]], axis=1)
+        out.append(np.tile(ang, (t, 1)))
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Host M-RoPE index computation (reference: HF get_rope_index semantics,
+# plumbed as rotary_position_ids in the reference runtime)
+# ---------------------------------------------------------------------------
+
+def get_rope_index(input_ids: np.ndarray, image_grid_thw: np.ndarray,
+                   image_token_id: int, spatial_merge: int,
+                   attention_mask: Optional[np.ndarray] = None
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """3-axis positions for image+text prompts.
+
+    Returns (positions (B, S, 3), decode_start (B, 3)) — text tokens count
+    sequentially on all axes; an image span holds t constant and counts its
+    (h, w) grid; the next text position resumes at max+1."""
+    ids = np.asarray(input_ids)
+    b, s = ids.shape
+    if attention_mask is None:
+        attention_mask = np.ones_like(ids)
+    pos = np.zeros((b, s, 3), np.int64)
+    decode_start = np.zeros((b, 3), np.int64)
+    img_idx = 0
+    for i in range(b):
+        row = ids[i][attention_mask[i] == 1]
+        out: List[np.ndarray] = []
+        st = 0          # index into row
+        st_pos = 0      # next sequential position value
+        while st < len(row):
+            if row[st] == image_token_id:
+                t, h, w = (int(x) for x in image_grid_thw[img_idx])
+                lh, lw_ = h // spatial_merge, w // spatial_merge
+                n = t * lh * lw_
+                ti = np.repeat(np.arange(t), lh * lw_) * 0 + st_pos
+                hi = np.tile(np.repeat(np.arange(lh), lw_), t) + st_pos
+                wi = np.tile(np.arange(lw_), t * lh) + st_pos
+                out.append(np.stack([ti, hi, wi], axis=1))
+                st += n
+                st_pos = st_pos + max(t, lh, lw_)
+                img_idx += 1
+            else:
+                ed = st
+                while ed < len(row) and row[ed] != image_token_id:
+                    ed += 1
+                n = ed - st
+                seq = np.arange(n) + st_pos
+                out.append(np.stack([seq] * 3, axis=1))
+                st = ed
+                st_pos += n
+        full = np.concatenate(out, axis=0)
+        pos[i, :len(full)] = full
+        decode_start[i] = full.max() + 1
+    return pos.astype(np.int32), decode_start.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Config + family + application
+# ---------------------------------------------------------------------------
+
+class Qwen2VLInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "image_token_id"]
+
+    def get_text_config(self) -> InferenceConfig:
+        tc = dict(self.text_config)
+        tc.setdefault("model_type", "qwen2")
+        return Qwen2VLTextConfig(self.tpu_config, **tc)
+
+
+class Qwen2VLTextConfig(Qwen2InferenceConfig):
+    pass
+
+
+@register_family("qwen2_vl_text")
+class Qwen2VLTextFamily(Qwen2Family):
+    """Text decoder = qwen2 + mrope sections (set via rope_scaling)."""
+    config_cls = Qwen2VLTextConfig
+
+
+class Qwen2VLApplication:
+    """Vision tower + M-RoPE text LM (reference: the qwen2_vl model set —
+    text wrapper modeling_qwen2_vl_text.py:189-339 + vision tower)."""
+
+    family = Qwen2VLTextFamily
+
+    def __init__(self, model_path: Optional[str],
+                 config: Qwen2VLInferenceConfig, mesh=None):
+        from ..application import CausalLMApplication
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        Qwen2VLTextFamily, mesh=mesh)
+        self.vision_spec = vision_spec_from_hf(dict(config.vision_config))
+        self.image_token_id = int(config.image_token_id)
+        self.spatial_merge = self.vision_spec.spatial_merge
+        self.vision_params = None
+        self._vis_fn = jax.jit(
+            lambda p, patches, cos, sin, ids: vision_forward(
+                self.vision_spec, p, patches, cos, sin, ids))
+
+    def load_weights(self):
+        from ...utils import checkpoint as ckpt
+        sd = ckpt.load_state_dict(self.model_path)
+        # text weights live under model.language_model.* (new HF layout) or
+        # model.* (old); normalize to model.*
+        remap = {}
+        for k, v in sd.items():
+            k2 = k.replace("model.language_model.", "model.")
+            k2 = k2.replace("model.visual.", "visual.")
+            remap[k2] = v
+        host = self.family.convert_hf_state_dict(remap, self.text.spec)
+        self.text._put_params(host)
+        self.vision_params = jax.tree.map(
+            jnp.asarray, convert_vision_tower(remap, self.vision_spec))
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def encode_images(self, pixel_patches: np.ndarray, grid_thw: np.ndarray
+                      ) -> jnp.ndarray:
+        """(N, patch_input) patches + (n_imgs, 3) grids -> merged features
+        (N/merge^2, text_hidden)."""
+        ang = vision_rot_angles(grid_thw, self.vision_spec)
+        ids = np.repeat(np.arange(len(grid_thw)),
+                        [int(t * h * w) for t, h, w in np.asarray(grid_thw)])
+        return self._vis_fn(self.vision_params, jnp.asarray(pixel_patches),
+                            jnp.asarray(np.cos(ang)), jnp.asarray(np.sin(ang)),
+                            jnp.asarray(ids))
+
+    def generate(self, input_ids: np.ndarray,
+                 pixel_patches: Optional[np.ndarray] = None,
+                 image_grid_thw: Optional[np.ndarray] = None,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32, **kw) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        image_embeds = image_mask = None
+        rope_pos = decode_start = None
+        if pixel_patches is not None:
+            feats = self.encode_images(pixel_patches, image_grid_thw)
+            image_mask = input_ids == self.image_token_id
+            per_row = image_mask.sum(axis=1)
+            if not (per_row == per_row[0]).all():
+                raise ValueError("rows must hold equal image-token counts "
+                                 "(pad with extra rows otherwise)")
+            image_embeds = np.asarray(feats).reshape(b, per_row[0], -1)
+            rope_pos, decode_start = get_rope_index(
+                input_ids, image_grid_thw, self.image_token_id,
+                self.spatial_merge, attention_mask)
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens, image_embeds=image_embeds,
+            image_mask=image_mask, rope_position_ids=rope_pos,
+            decode_rope_start=decode_start, **kw)
+
+    def reset(self):
+        self.text.reset()
+        return self

@@ -1,0 +1,229 @@
+"""Pallas decode (token-generation) attention — TPU-native replacement for
+the reference's NKI TKG attention kernels
+(reference: modules/attention/attention_base.py:1186-1382 ``attention_block_tkg``
+mega-kernel path and :1383-1461 decomposed prior+active attention).
+
+Decomposition (same as the reference's decomposed TKG attention): the new
+token's K/V never round-trips through the cache for the score computation —
+the kernel attends over the PRIOR cache rows (0..pos_b-1) plus the ACTIVE
+token handled in-registers, so the cache scatter write can be scheduled
+independently by XLA.
+
+The win over the XLA path is bandwidth: the grid walks cache blocks along S
+and collapses every block past each row's live length onto the last live
+block via the BlockSpec index map — Pallas elides the DMA when consecutive
+grid steps map to the same block, so a 4k-slot cache at position 500 streams
+~512 slots, not 4096 (the reference kernel gets the same effect from
+explicit DMA skipping, kvcache/utils.py batch-write kernel).
+
+Layouts: q (B, Hq, D); k/v cache (B, S, Hkv, D) per-layer slice (strided on
+H inside a block — the S-major cache layout is shared with the XLA path);
+new k/v (B, Hkv, D). All softmax math fp32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.3819763e38
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
+                   o_ref, acc_ref, m_ref, l_ref, *,
+                   scale: float, block_s: int,
+                   soft_cap: Optional[float], has_sink: bool):
+    """Scalar-prefetch layout: lens_ref = [layer_idx, window, len_0, ...,
+    len_{B-1}] (layer_idx consumed by the index maps of the stacked-cache
+    variant; window is DYNAMIC so alternating local/global layer patterns
+    can pass their per-layer window through one scan body — reference:
+    gemma3 / gpt_oss alternating attention, SURVEY §2.7)."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    pos = lens_ref[2 + b]                   # prior length of this row
+    w = lens_ref[1]                         # sliding window (0 = unlimited)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    k_start = j * block_s
+    in_window = jnp.logical_or(w == 0, k_start + block_s > pos - w)
+
+    @pl.when(jnp.logical_and(k_start < pos, in_window))
+    def _prior():
+        q = q_ref[0, 0].astype(jnp.float32)                # (G, D)
+        k = k_ref[0, 0, :, 0].astype(jnp.float32)          # (bs, D)
+        v = v_ref[0, 0, :, 0].astype(jnp.float32)          # (bs, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)          # (G, bs)
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        valid = kpos < pos
+        valid = jnp.logical_and(
+            valid, jnp.logical_or(w == 0, pos - kpos < w))
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        l_ref[:, 0:1] = l_ref[:, 0:1] * alpha + jnp.sum(p, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, 0:1] = m_cur
+
+    @pl.when(j == nj - 1)
+    def _active_and_finalize():
+        # active token: its score joins the softmax; its V joins the acc
+        q = q_ref[0, 0].astype(jnp.float32)                # (G, D)
+        kn = nk_ref[0].astype(jnp.float32)                 # (1, D)
+        vn = nv_ref[0].astype(jnp.float32)                 # (1, D)
+        s = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)          # (G, 1)
+        m_prev = m_ref[:, 0:1]
+        m_cur = jnp.maximum(m_prev, s)
+        if has_sink:
+            # learned per-head sink joins the denominator only
+            # (reference: modules/attention/sink.py)
+            sk = sink_ref[0].astype(jnp.float32)[:, None]  # (G, 1)
+            m_cur = jnp.maximum(m_cur, sk)
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)                             # (G, 1)
+        l_new = l_ref[:, 0:1] * alpha + p
+        if has_sink:
+            l_new = l_new + jnp.exp(sk - m_cur)
+        acc = acc_ref[:] * alpha + p * vn                  # (G, D)
+        o_ref[0, 0] = (acc / l_new).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "window", "soft_cap", "block_s", "interpret"))
+def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, new_k: jnp.ndarray,
+                     new_v: jnp.ndarray, lens: jnp.ndarray, *,
+                     scale: float, window: int = 0,
+                     soft_cap: Optional[float] = None,
+                     sink: Optional[jnp.ndarray] = None,
+                     block_s: int = 256, interpret: bool = False
+                     ) -> jnp.ndarray:
+    """One-token decode attention over prior cache + active token.
+
+    q (B, Hq, D); k_cache/v_cache (B, S, Hkv, D) — rows [0, lens[b]) valid;
+    new_k/new_v (B, Hkv, D) the active token's K/V (NOT yet required to be
+    in the cache); lens (B,) int32 prior lengths; sink (Hq,) optional learned
+    softmax sink logits. Returns (B, Hq, D).
+    """
+    return decode_attention_stacked(
+        q, k_cache[None], v_cache[None], new_k, new_v,
+        jnp.zeros((), jnp.int32), lens, scale=scale,
+        window=jnp.asarray(window, jnp.int32), soft_cap=soft_cap, sink=sink,
+        block_s=block_s, interpret=interpret)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "soft_cap", "block_s", "interpret"))
+def decode_attention_stacked(q: jnp.ndarray, k_cache: jnp.ndarray,
+                             v_cache: jnp.ndarray, new_k: jnp.ndarray,
+                             new_v: jnp.ndarray, layer: jnp.ndarray,
+                             lens: jnp.ndarray, *,
+                             scale: float,
+                             window: Optional[jnp.ndarray] = None,
+                             soft_cap: Optional[float] = None,
+                             sink: Optional[jnp.ndarray] = None,
+                             block_s: int = 256, interpret: bool = False
+                             ) -> jnp.ndarray:
+    """Decode attention reading layer ``layer`` (traced scalar — inside the
+    layer scan) directly out of the FULL stacked cache (L, B, S, Hkv, D):
+    no per-layer dynamic-slice materialization between the carry and the
+    kernel; the index maps address the layer through scalar prefetch."""
+    b, hq, d = q.shape
+    s = k_cache.shape[2]
+    hkv = k_cache.shape[3]
+    g = hq // hkv
+    block_s = min(block_s, s)
+    nj = pl.cdiv(s, block_s)
+
+    qr = q.reshape(b, hkv, g, d)
+    sink_in = (sink.reshape(hkv, g) if sink is not None
+               else jnp.zeros((hkv, g), jnp.float32))
+
+    def q_map(bi, h, j, sc):
+        return (bi, h, 0, 0)
+
+    def kv_map(bi, h, j, sc):
+        # clamp to the live [window-start, prefix-end] block range:
+        # consecutive identical indices -> Pallas skips the DMA
+        pos_b = sc[2 + bi]
+        last_live = jax.lax.max(
+            jax.lax.div(jax.lax.max(pos_b - 1, 0), block_s), 0)
+        w = sc[1]
+        first_live = jax.lax.select(
+            w > 0, jax.lax.max(jax.lax.div(jax.lax.max(pos_b - w, 0),
+                                           block_s), 0), 0)
+        return (sc[0], bi,
+                jax.lax.min(jax.lax.max(j, first_live), last_live), h, 0)
+
+    def nkv_map(bi, h, j, sc):
+        return (bi, h, 0)
+
+    def sink_map(bi, h, j, sc):
+        return (h, 0)
+
+    grid = (b, hkv, nj)
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_s=block_s,
+        soft_cap=soft_cap, has_sink=sink is not None)
+    if window is None:
+        window = jnp.zeros((), jnp.int32)
+    scalars = jnp.concatenate([
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.asarray(window, jnp.int32).reshape(1), lens.astype(jnp.int32)])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d), q_map),
+                pl.BlockSpec((1, 1, block_s, 1, d), kv_map),
+                pl.BlockSpec((1, 1, block_s, 1, d), kv_map),
+                pl.BlockSpec((1, 1, d), nkv_map),
+                pl.BlockSpec((1, 1, d), nkv_map),
+                pl.BlockSpec((1, g), sink_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((g, d), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+                pltpu.VMEM((g, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        interpret=interpret,
+    )(scalars, qr, k_cache, v_cache,
+      new_k.reshape(b, hkv, 1, d)[:, :, 0], new_v.reshape(b, hkv, 1, d)[:, :, 0],
+      sink_in)
+    return out.reshape(b, hq, d)
+
+
+def supports(spec, phase_t: int) -> bool:
+    """Kernel admission (reference analog: TKG kernel enablement flags,
+    models/config.py:417-567): single active token, no MLA (different head
+    dims), uniform-window handled per-layer by the caller."""
+    return (phase_t == 1 and spec.mla is None
+            and spec.head_dim in (64, 128) and spec.attn_soft_cap is None)

@@ -10,9 +10,17 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
+
+
+def np_one_hot(idx: Sequence[int], depth: int) -> np.ndarray:
+    """(len(idx), depth) fp32 one-hot built at trace time."""
+    out = np.zeros((len(idx), depth), np.float32)
+    out[np.arange(len(idx)), np.asarray(idx)] = 1.0
+    return out
 
 
 @dataclass(frozen=True)
@@ -34,6 +42,12 @@ class RopeConfig:
     mscale_all_dim: float = 0.0
     attention_factor: Optional[float] = None  # cos/sin multiplier; None=derive
     truncate: bool = True
+    # M-RoPE (Qwen2-VL / Qwen2.5-VL — reference: models/qwen2_vl/
+    # modeling_qwen2_vl_text.py:52 ``apply_multimodal_rotary_pos_emb``):
+    # positions are 3-axis (temporal, height, width); freq slot i takes its
+    # angle from the axis owning it — slots [0,s0) temporal, [s0,s0+s1)
+    # height, [s0+s1,s0+s1+s2) width. sum(mrope_section) == dim/2.
+    mrope_section: Optional[Tuple[int, ...]] = None
 
     @property
     def dim(self) -> int:
@@ -111,9 +125,23 @@ def compute_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
 
 def rope_cos_sin(position_ids: jnp.ndarray, cfg: RopeConfig
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """(B, S) int positions -> cos/sin of shape (B, S, dim/2), fp32."""
+    """(B, S) int positions -> cos/sin of shape (B, S, dim/2), fp32.
+
+    M-RoPE: (B, S, 3) positions + cfg.mrope_section -> each freq slot takes
+    its angle from its owning axis (text tokens pass t == h == w, recovering
+    plain RoPE)."""
     inv_freq = compute_inv_freq(cfg)
-    angles = position_ids.astype(jnp.float32)[..., None] * inv_freq  # (B,S,d/2)
+    if cfg.mrope_section is not None and position_ids.ndim == 3:
+        angles3 = (position_ids.astype(jnp.float32)[..., None]
+                   * inv_freq)                     # (B, S, 3, d/2)
+        axis_of_slot = sum(([ax] * n for ax, n in
+                            enumerate(cfg.mrope_section)), [])
+        sel = jnp.asarray(np_one_hot(axis_of_slot, angles3.shape[2]))
+        angles = jnp.einsum("bsad,da->bsd", angles3, sel)
+    else:
+        if position_ids.ndim == 3:
+            position_ids = position_ids[..., 0]
+        angles = position_ids.astype(jnp.float32)[..., None] * inv_freq
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     if cfg.scaling_type == "yarn":
         f = yarn_attention_factor(cfg)

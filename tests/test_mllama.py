@@ -1,0 +1,127 @@
+"""MLlama golden tests: cross-attention decoder + multimodal cross-KV cache
+vs HF (reference: models/mllama/, multimodal_kv_cache_manager.py —
+SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.mllama import (
+    MllamaApplication, build_mllama_plan)
+
+
+class _Cfg:
+    pass
+
+
+@pytest.fixture(scope="module")
+def hf_mllama_text(tmp_path_factory):
+    from transformers.models.mllama.configuration_mllama import \
+        MllamaTextConfig
+    from transformers.models.mllama.modeling_mllama import MllamaForCausalLM
+    torch.manual_seed(0)
+    cfg = MllamaTextConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=5,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=300,
+        rms_norm_eps=1e-5, max_position_embeddings=256, rope_theta=10000.0,
+        cross_attention_layers=[1, 3], tie_word_embeddings=False,
+        pad_token_id=0, rope_scaling={"rope_type": "default"},
+        torch_dtype="float32")
+    m = MllamaForCausalLM(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("mllama")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def test_plan():
+    p = build_mllama_plan(5, (1, 3))
+    assert p.segments == ((1, True), (1, True), (1, False))
+    assert p.num_self == 3 and p.num_cross == 2
+
+
+def test_mllama_text_matches_hf(hf_mllama_text):
+    import transformers.models.mllama.modeling_mllama as mm
+    m, cfg, d = hf_mllama_text
+    rng = np.random.default_rng(0)
+    B, S, SV = 2, 10, 6
+    ids = rng.integers(5, 295, (B, S))
+    vs = rng.normal(size=(B, SV, cfg.hidden_size)).astype(np.float32)
+
+    tcfg = TpuConfig(batch_size=B, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    app_cfg = _Cfg()
+    app_cfg.tpu_config = tcfg
+    app_cfg.text_config = cfg.to_dict()
+    app = MllamaApplication.__new__(MllamaApplication)
+    MllamaApplication.__init__(app, d, type("C", (), {
+        "tpu_config": tcfg, "text_config": cfg.to_dict()})())
+    app.load_weights().init_cache()
+
+    res = app.generate(ids.astype(np.int32), vs, max_new_tokens=6)
+    seqs = res["sequences"]
+
+    # teacher-forced HF forward over OUR sequence; every position's logits
+    # must match (validates prefill + every decode step incl. cross-KV reuse)
+    full_ids = torch.tensor(seqs[:, :-1].astype(np.int64))
+    T = full_ids.shape[1]
+    cam = torch.ones(B, T, 1, 1)
+    full_mask, row_mask = mm._prepare_cross_attention_mask(
+        cam, num_vision_tokens=SV, dtype=torch.float32)
+    with torch.no_grad():
+        hf_logits = m(input_ids=full_ids,
+                      cross_attention_states=torch.tensor(vs),
+                      cross_attention_mask=full_mask,
+                      full_text_row_masked_out_mask=row_mask).logits.numpy()
+
+    got_prefill = np.asarray(res["logits"][0])[:, :S]
+    np.testing.assert_allclose(got_prefill, hf_logits[:, :S],
+                               atol=5e-3, rtol=1e-3)
+    for i in range(1, len(res["logits"])):
+        np.testing.assert_allclose(
+            np.asarray(res["logits"][i]).reshape(B, -1),
+            hf_logits[:, S + i - 1], atol=5e-3, rtol=1e-3,
+            err_msg=f"decode step {i}")
+    # greedy equivalence where HF argmax is decisive
+    top2 = np.sort(hf_logits, axis=-1)[..., -2:]
+    decisive = (top2[..., 1] - top2[..., 0]) > 0.1
+    gen = res["generated"]
+    want = hf_logits[:, S - 1:].argmax(-1)
+    n = gen.shape[1]
+    mism = (gen[:, :n] != want[:, :n]) & decisive[:, S - 1:S - 1 + n]
+    assert not mism.any()
+
+
+def test_mllama_row_masked_out(hf_mllama_text):
+    """Rows with no attendable vision tokens follow HF's uniform-attend +
+    suppressed-MLP semantics."""
+    import transformers.models.mllama.modeling_mllama as mm
+    m, cfg, d = hf_mllama_text
+    rng = np.random.default_rng(1)
+    B, S, SV = 2, 8, 4
+    ids = rng.integers(5, 295, (B, S))
+    vs = rng.normal(size=(B, SV, cfg.hidden_size)).astype(np.float32)
+    # row 0: first half of text rows masked off entirely
+    cross_mask = np.ones((B, S, SV), bool)
+    cross_mask[0, :4, :] = False
+
+    tcfg = TpuConfig(batch_size=B, seq_len=32, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    app = MllamaApplication(d, type("C", (), {
+        "tpu_config": tcfg, "text_config": cfg.to_dict()})())
+    app.load_weights().init_cache()
+    res = app.generate(ids.astype(np.int32), vs,
+                       cross_attention_mask=cross_mask, max_new_tokens=1)
+
+    cam = torch.ones(B, S, 1, 1)
+    cam[0, :4] = 0
+    full_mask, row_mask = mm._prepare_cross_attention_mask(
+        cam, num_vision_tokens=SV, dtype=torch.float32)
+    with torch.no_grad():
+        hf_logits = m(input_ids=torch.tensor(ids.astype(np.int64)),
+                      cross_attention_states=torch.tensor(vs),
+                      cross_attention_mask=full_mask,
+                      full_text_row_masked_out_mask=row_mask).logits.numpy()
+    got = np.asarray(res["logits"][0])[:, :S]
+    np.testing.assert_allclose(got, hf_logits, atol=5e-3, rtol=1e-3)

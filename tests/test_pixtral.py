@@ -1,0 +1,72 @@
+"""Pixtral golden test: Pixtral ViT + llava merge + mistral text vs HF
+(reference: models/pixtral/ — SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.pixtral import (
+    PixtralApplication, PixtralInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def hf_pixtral(tmp_path_factory):
+    from transformers import (LlavaConfig, LlavaForConditionalGeneration,
+                              MistralConfig, PixtralVisionConfig)
+    torch.manual_seed(0)
+    vis = PixtralVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=8,
+        rope_theta=10000.0, torch_dtype="float32")
+    txt = MistralConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=300,
+        rms_norm_eps=1e-5, max_position_embeddings=256,
+        tie_word_embeddings=False, torch_dtype="float32")
+    cfg = LlavaConfig(vision_config=vis, text_config=txt,
+                      image_token_index=7,
+                      vision_feature_layer=-1,
+                      vision_feature_select_strategy="full",
+                      projector_hidden_act="gelu")
+    m = LlavaForConditionalGeneration(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("pixtral")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def test_pixtral_matches_hf(hf_pixtral):
+    m, cfg, d = hf_pixtral
+    rng = np.random.default_rng(0)
+    b = 2
+    pixels = rng.normal(size=(b, 3, 32, 32)).astype(np.float32)
+    n_img = (32 // 8) ** 2        # 16 patch tokens per image
+    row = [7] * n_img + rng.integers(10, 290, 6).tolist()
+    ids = np.stack([row, [7] * n_img + rng.integers(10, 290, 6).tolist()])
+
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=False)
+    icfg = PixtralInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        image_token_index=cfg.image_token_index, model_type="pixtral")
+    app = PixtralApplication(d, icfg).load_weights().init_cache()
+
+    # vision tower golden (last hidden state)
+    with torch.no_grad():
+        hf_feats = m.model.vision_tower(
+            torch.tensor(pixels),
+            image_sizes=torch.tensor([[32, 32]] * b)).last_hidden_state
+        hf_proj = m.model.multi_modal_projector(hf_feats).numpy()
+    got = np.asarray(app.encode_images(pixels))
+    np.testing.assert_allclose(got.reshape(hf_proj.shape), hf_proj,
+                               atol=2e-4, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_seq = m.generate(input_ids=torch.tensor(ids.astype(np.int64)),
+                            pixel_values=torch.tensor(pixels),
+                            image_sizes=torch.tensor([[32, 32]] * b),
+                            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixels, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)

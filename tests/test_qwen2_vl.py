@@ -1,0 +1,96 @@
+"""Qwen2-VL golden test: M-RoPE text decoder + vision tower vs HF
+(reference: models/qwen2_vl/ — SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.qwen2_vl import (
+    Qwen2VLApplication, Qwen2VLInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+    torch.manual_seed(0)
+    cfg = Qwen2VLConfig(
+        text_config=dict(
+            hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, vocab_size=300,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            rope_theta=10000.0, max_position_embeddings=256,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            torch_dtype="float32"),
+        vision_config=dict(
+            depth=2, embed_dim=32, num_heads=2, in_channels=3,
+            hidden_size=64, patch_size=4, spatial_merge_size=2,
+            temporal_patch_size=2, mlp_ratio=2.0, torch_dtype="float32"),
+        image_token_id=7, vision_start_token_id=5, vision_end_token_id=6)
+    m = Qwen2VLForConditionalGeneration(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("qwen2vl")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def _build_inputs(cfg, b=2, grid=(1, 4, 4), n_text=6):
+    rng = np.random.default_rng(0)
+    t, h, w = grid
+    merge = cfg.vision_config.spatial_merge_size
+    n_img_tok = t * (h // merge) * (w // merge)
+    row = ([5] + [7] * n_img_tok + [6]
+           + rng.integers(10, 290, n_text).tolist())
+    ids = np.stack([np.asarray(row)] * b)
+    ids[1, -n_text:] = rng.integers(10, 290, n_text)
+    patch_dim = (cfg.vision_config.in_channels
+                 * cfg.vision_config.temporal_patch_size
+                 * cfg.vision_config.patch_size ** 2)
+    patches = rng.normal(size=(b * t * h * w, patch_dim)).astype(np.float32)
+    grid_thw = np.asarray([[t, h, w]] * b)
+    return ids.astype(np.int64), patches, grid_thw
+
+
+def test_qwen2_vl_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    ids, patches, grid_thw = _build_inputs(cfg)
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Qwen2VLInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        image_token_id=cfg.image_token_id, model_type="qwen2_vl")
+    app = Qwen2VLApplication(d, icfg).load_weights().init_cache()
+
+    # vision tower golden
+    with torch.no_grad():
+        hf_feats = m.model.visual(torch.tensor(patches),
+                                  grid_thw=torch.tensor(grid_thw)).numpy()
+    got_feats = np.asarray(app.encode_images(patches, grid_thw))
+    np.testing.assert_allclose(got_feats, hf_feats, atol=2e-4, rtol=1e-3)
+
+    # end-to-end greedy generation golden
+    with torch.no_grad():
+        hf_seq = m.generate(
+            input_ids=torch.tensor(ids),
+            pixel_values=torch.tensor(patches),
+            image_grid_thw=torch.tensor(grid_thw),
+            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixel_patches=patches,
+                       image_grid_thw=grid_thw, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+
+
+def test_mrope_text_only_equals_plain_rope():
+    """Text-only prompts (t == h == w) must reproduce plain RoPE."""
+    import jax.numpy as jnp
+    from neuronx_distributed_inference_tpu.ops.rope import (RopeConfig,
+                                                            rope_cos_sin)
+    pos = np.arange(10)[None, :]
+    plain = RopeConfig(head_dim=16)
+    mr = RopeConfig(head_dim=16, mrope_section=(2, 3, 3))
+    c0, s0 = rope_cos_sin(jnp.asarray(pos), plain)
+    pos3 = np.stack([pos] * 3, axis=-1)
+    c1, s1 = rope_cos_sin(jnp.asarray(pos3), mr)
+    np.testing.assert_allclose(np.asarray(c0), np.asarray(c1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-6)
