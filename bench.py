@@ -44,9 +44,14 @@ def main():
         head_dim=64, vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
         hidden_act="silu", tie_word_embeddings=True,
     )
+    # TKG seq bucketing on: decode graphs read only cache[:bucket] — early
+    # decode streams a fraction of the allocated KV (reference: TKG seq
+    # buckets, autobucketing.py:226)
     tcfg = TpuConfig(batch_size=batch, seq_len=seq_len,
                      max_context_length=prompt_len, dtype="bfloat16",
-                     enable_bucketing=False, decode_chunk_tokens=chunk)
+                     enable_bucketing=True,
+                     context_encoding_buckets=[prompt_len],
+                     decode_chunk_tokens=chunk)
     icfg = LlamaInferenceConfig(tcfg, **hf_attrs)
     mesh = build_mesh(MeshConfig(tp=1))
     app = CausalLMApplication(None, icfg, LlamaFamily, mesh=mesh)

@@ -108,6 +108,7 @@ class CausalLMApplication:
         """Shard-on-load; quantize first when the config asks for it
         (reference: application_base.py:746-799 quantize-and-save path)."""
         from ..modules import quantization as quant
+        host = model_base.fuse_qkv_host(host)
         fp_shardings = model_base.param_shardings(self.spec, self.mesh)
         if self.spec.quant is None:
             self.params = ckpt.device_put_params(host, fp_shardings,
@@ -173,23 +174,37 @@ class CausalLMApplication:
         fn = partial(model_base.context_encoding_step, self.spec, self.tpu_config)
         return jax.jit(fn, donate_argnums=(1,))
 
-    def _jit_decode(self):
-        fn = partial(model_base.token_generation_step, self.spec, self.tpu_config)
+    def _jit_decode(self, kv_bucket: Optional[int] = None):
+        fn = partial(model_base.token_generation_step, self.spec,
+                     self.tpu_config, kv_view=kv_bucket)
         return jax.jit(fn, donate_argnums=(1,))
 
-    def _jit_decode_loop(self, num_steps: int):
-        fn = partial(model_base.decode_loop, self.spec, self.tpu_config)
+    def _jit_decode_loop(self, num_steps: int,
+                         kv_bucket: Optional[int] = None):
+        fn = partial(model_base.decode_loop, self.spec, self.tpu_config,
+                     kv_view=kv_bucket)
         return jax.jit(fn, static_argnames=("num_steps",), donate_argnums=(1,))
 
-    def get_compiled(self, tag: str, bucket: int = 0):
+    def _kv_bucket(self, needed: int) -> Optional[int]:
+        """Smallest TKG seq bucket covering ``needed`` cache slots — the
+        decode graph compiled for bucket b reads cache[:b] only (reference:
+        TKG seq buckets, autobucketing.py:226). None = full cache."""
+        buckets = self.tkg_buckets
+        if len(buckets) <= 1:
+            return None
+        return autobucketing.get_target_bucket(buckets, needed)
+
+    def get_compiled(self, tag: str, bucket=0):
         key = (tag, bucket)
         if key not in self._compiled:
             if tag == CONTEXT_ENCODING_MODEL_TAG:
                 self._compiled[key] = self._jit_prefill()
             elif tag == TOKEN_GENERATION_MODEL_TAG:
-                self._compiled[key] = self._jit_decode()
+                self._compiled[key] = self._jit_decode(bucket or None)
             elif tag == "decode_loop":
-                self._compiled[key] = self._jit_decode_loop(bucket)
+                steps, kv_bucket = bucket if isinstance(bucket, tuple) \
+                    else (bucket, None)
+                self._compiled[key] = self._jit_decode_loop(steps, kv_bucket)
             else:
                 raise KeyError(tag)
         return self._compiled[key]
@@ -220,17 +235,32 @@ class CausalLMApplication:
                               np.zeros((b,), np.int32) + 1)
         bt = cfg.tkg_batch_size
         chunk = max(cfg.decode_chunk_tokens, 1)
-        if chunk > 1:
-            self._run_decode_loop(np.zeros((bt,), np.int32),
-                                  np.ones((bt,), np.int32), chunk)
-        else:
+        # compile every TKG seq bucket (reference: warmup runs every bucket
+        # of every submodel, application_base.py:349-373)
+        starts = [1] if len(self.tkg_buckets) <= 1 else [
+            max(b - chunk, 1) for b in self.tkg_buckets]
+        for start in starts:
+            if chunk > 1:
+                self._run_decode_loop(np.zeros((bt,), np.int32),
+                                      np.full((bt,), start, np.int32), chunk)
+            # the chunk tail of generate() uses the single-step graph —
+            # warm it per bucket too, or the first request reaching a new
+            # bucket stalls on a mid-request compile
             self._run_decode(np.zeros((bt, 1), np.int32),
-                             np.ones((bt, 1), np.int32))
+                             np.full((bt, 1), start, np.int32))
         return self
 
     # ------------------------------------------------------------------
     # execution helpers
     # ------------------------------------------------------------------
+    def _mesh_ctx(self):
+        """Execute compiled fns inside the mesh context: bare-PartitionSpec
+        sharding constraints in model code resolve against it, and
+        ops/decode_attention.dispatch reads it to shard_map the Pallas
+        kernel over the dp/mp axes (outside a mesh context both silently
+        degrade to GSPMD-propagated-only sharding)."""
+        return jax.sharding.set_mesh(self.mesh)
+
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
         return k
@@ -250,6 +280,14 @@ class CausalLMApplication:
         b, s = input_ids.shape
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
+        elif (not self.tpu_config.is_continuous_batching
+              and not np.array_equal(np.asarray(seq_ids), np.arange(b))):
+            # the prefill graph takes the identity fast-path write under
+            # this static config (kv_cache.write_prefill_at_layer), which
+            # would silently ignore a row permutation — reject at the
+            # boundary like _run_decode does
+            raise ValueError("non-identity seq_ids require "
+                             "is_continuous_batching=True")
         position_ids = np.broadcast_to(np.arange(s, dtype=np.int32), (b, s))
         fn = self.get_compiled(CONTEXT_ENCODING_MODEL_TAG, s)
         if sampling_params is None:
@@ -264,11 +302,12 @@ class CausalLMApplication:
             image_mask = jnp.asarray(np.asarray(image_mask, bool))
         if rope_position_ids is not None:
             rope_position_ids = jnp.asarray(rope_position_ids)
-        out = fn(self.params, self.cache, jnp.asarray(input_ids),
-                 jnp.asarray(position_ids), jnp.asarray(seq_ids),
-                 jnp.asarray(seq_lens), sampling_params, self._next_rng(),
-                 adapter_ids, self.replacements, image_embeds, image_mask,
-                 rope_position_ids)
+        with self._mesh_ctx():
+            out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                     jnp.asarray(position_ids), jnp.asarray(seq_ids),
+                     jnp.asarray(seq_lens), sampling_params, self._next_rng(),
+                     adapter_ids, self.replacements, image_embeds, image_mask,
+                     rope_position_ids)
         self.cache = out["cache"]
         return out
 
@@ -285,7 +324,9 @@ class CausalLMApplication:
             # silently read the wrong rows — reject at the boundary
             raise ValueError("non-identity seq_ids require "
                              "is_continuous_batching=True")
-        fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG)
+        needed = int(np.max(np.asarray(position_ids))) + input_ids.shape[1]
+        fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG,
+                               self._kv_bucket(needed) or 0)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
         if self.snapshot.enabled:
@@ -294,10 +335,11 @@ class CausalLMApplication:
                                      "seq_ids": seq_ids})
         if rope_position_ids is not None:
             rope_position_ids = jnp.asarray(rope_position_ids)
-        out = fn(self.params, self.cache, jnp.asarray(input_ids),
-                 jnp.asarray(position_ids), jnp.asarray(seq_ids),
-                 sampling_params, self._next_rng(), adapter_ids,
-                 self.replacements, rope_position_ids)
+        with self._mesh_ctx():
+            out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                     jnp.asarray(position_ids), jnp.asarray(seq_ids),
+                     sampling_params, self._next_rng(), adapter_ids,
+                     self.replacements, rope_position_ids)
         self.cache = out["cache"]
         return out
 
@@ -308,15 +350,19 @@ class CausalLMApplication:
         b = first_tokens.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
-        fn = self.get_compiled("decode_loop", num_steps)
+        needed = int(np.max(np.asarray(positions))) + num_steps
+        fn = self.get_compiled("decode_loop",
+                               (num_steps, self._kv_bucket(needed)))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
         if rope_position_ids is not None:
             rope_position_ids = jnp.asarray(rope_position_ids)
-        out = fn(self.params, self.cache, jnp.asarray(first_tokens),
-                 jnp.asarray(positions), jnp.asarray(seq_ids), sampling_params,
-                 self._next_rng(), num_steps=num_steps,
-                 adapter_ids=adapter_ids, rope_position_ids=rope_position_ids)
+        with self._mesh_ctx():
+            out = fn(self.params, self.cache, jnp.asarray(first_tokens),
+                     jnp.asarray(positions), jnp.asarray(seq_ids),
+                     sampling_params, self._next_rng(), num_steps=num_steps,
+                     adapter_ids=adapter_ids,
+                     rope_position_ids=rope_position_ids)
         self.cache = out["cache"]
         return out
 
@@ -658,10 +704,11 @@ class PagedCausalLMApplication(CausalLMApplication):
         fn = self.get_compiled("paged_forward")
         if sampling_params is None:
             sampling_params = self._default_sampling_params(input_ids.shape[0])
-        out = fn(self.params, self.cache, jnp.asarray(input_ids),
-                 jnp.asarray(position_ids), jnp.asarray(slot_mapping),
-                 jnp.asarray(block_table), jnp.asarray(last_idx),
-                 sampling_params, self._next_rng())
+        with self._mesh_ctx():
+            out = fn(self.params, self.cache, jnp.asarray(input_ids),
+                     jnp.asarray(position_ids), jnp.asarray(slot_mapping),
+                     jnp.asarray(block_table), jnp.asarray(last_idx),
+                     sampling_params, self._next_rng())
         self.cache = out["cache"]
         return out
 

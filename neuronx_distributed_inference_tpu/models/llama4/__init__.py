@@ -1,0 +1,2 @@
+from .modeling_llama4 import (Llama4Family, Llama4InferenceConfig,  # noqa: F401
+                              Llama4VLApplication)

@@ -1,0 +1,450 @@
+"""Llama4 (Scout/Maverick) family — text decoder with chunked attention +
+NoPE layers + interleaved dense/MoE stacks, and the vision encoder
+(reference: models/llama4/modeling_llama4_text.py :1-770,
+modeling_llama4_vision.py :1-1214, modeling_llama4.py — SURVEY §2.7,
+~2994 LoC; named in BASELINE.json).
+
+All text deltas are DecoderSpec knobs (model_base.py), not a separate layer
+implementation:
+  * chunked attention on RoPE layers (``attn_chunk`` block-diagonal mask;
+    reference: chunked-attention CTE, attention_base.py:916-948)
+  * NoPE global layers every ``no_rope_layer_interval`` (``nope_global`` —
+    identity rotation) with attention temperature tuning (``attn_temp``)
+  * weightless L2 q/k norm after rope on rope layers (``qk_l2_norm``)
+  * interleaved dense/MoE (``moe_pattern`` from HF ``moe_layers``) with
+    llama4 routing: sigmoid(top-1 logit) scales the expert INPUT, plus an
+    always-on shared expert (modules/moe.py ``input_scaled``)
+
+The vision side is a functional ViT with 2-D rope + pixel-shuffle adapter +
+multimodal projector feeding ``image_embeds`` into the shared multimodal
+prefill merge (model_base.context_encoding_step image_embeds/image_mask).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig
+from ...modules.moe import MoESpec
+from ..family import DecoderFamily, register_family
+from ..model_base import DecoderSpec, spec_from_config
+from ...parallel.layers import place_q_weight, replicate_kv_weight
+
+
+class Llama4InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size",
+                "intermediate_size_mlp", "num_local_experts",
+                "num_experts_per_tok"]
+
+    def get_text_config(self):
+        return self
+
+
+@register_family("llama4_text", "llama4")
+class Llama4Family(DecoderFamily):
+    config_cls = Llama4InferenceConfig
+
+    @classmethod
+    def build_spec(cls, config: InferenceConfig,
+                   tp_degree: Optional[int] = None) -> DecoderSpec:
+        L = config.num_hidden_layers
+        no_rope = getattr(config, "no_rope_layers", None)
+        if not no_rope:
+            interval = getattr(config, "no_rope_layer_interval", 4)
+            no_rope = [int((i + 1) % interval != 0) for i in range(L)]
+        # local (pattern True) = rope + chunked attention; global = NoPE full
+        pattern = tuple(bool(r) for r in no_rope)
+        moe_layers = getattr(config, "moe_layers", None)
+        if moe_layers is None:
+            step = getattr(config, "interleave_moe_layer_step", 1)
+            moe_layers = list(range(step - 1, L, step))
+        moe_set = set(int(i) for i in moe_layers)
+        moe = MoESpec(
+            num_experts=config.num_local_experts,
+            top_k=config.num_experts_per_tok,
+            intermediate_size=config.intermediate_size,
+            normalize_topk=False,
+            router_act="sigmoid",
+            input_scaled=True,
+            shared_intermediate=config.intermediate_size,
+            act=getattr(config, "hidden_act", "silu"),
+        ) if moe_set else None
+        temp = ((float(getattr(config, "floor_scale", 8192)),
+                 float(getattr(config, "attn_scale", 0.1)))
+                if getattr(config, "attn_temperature_tuning", True) else None)
+        chunk = int(getattr(config, "attention_chunk_size", 8192) or 0)
+        return spec_from_config(
+            config, tp_degree,
+            intermediate_size=config.intermediate_size_mlp,
+            layer_pattern=pattern,
+            attn_chunk=chunk,
+            nope_global=True,
+            qk_l2_norm=bool(getattr(config, "use_qk_norm", True)),
+            attn_temp=temp,
+            rope_interleaved=True,
+            moe=moe,
+            moe_pattern=tuple(i in moe_set for i in range(L)) if moe_set
+            else None,
+            qkv_bias=bool(getattr(config, "attention_bias", False)),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd: Dict[str, np.ndarray],
+                              spec: DecoderSpec) -> Dict[str, Any]:
+        """Two stacks: dense layers ("layers") and MoE layers ("moe_layers"),
+        each in order of appearance (reference: llama4 conversion scripts,
+        models/llama4/conversion_script/)."""
+        p = cls.hf_prefix
+        g, D = spec.gqa, spec.head_dim
+        L = spec.num_layers
+        pat = spec.moe_pattern or (False,) * L
+        moe_ids = [i for i in range(L) if pat[i]]
+        dense_ids = [i for i in range(L) if not pat[i]]
+
+        def get(name):
+            return np.asarray(sd[name])
+
+        def q_t(w):
+            return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=-1)
+
+        def kv_t(w):
+            return replicate_kv_weight(np.ascontiguousarray(w.T), g, D,
+                                       axis=-1)
+
+        def o_t(w):
+            return place_q_weight(np.ascontiguousarray(w.T), g, D, axis=0)
+
+        def t(w):
+            return np.ascontiguousarray(w.T)
+
+        def stack(ids, fmt, tr):
+            return np.stack([tr(get(fmt.format(i=i))) for i in ids])
+
+        def attn_stack(ids):
+            return {
+                "input_norm": stack(ids, p + ".layers.{i}.input_layernorm.weight",
+                                    np.asarray),
+                "q_proj": stack(ids, p + ".layers.{i}.self_attn.q_proj.weight", q_t),
+                "k_proj": stack(ids, p + ".layers.{i}.self_attn.k_proj.weight", kv_t),
+                "v_proj": stack(ids, p + ".layers.{i}.self_attn.v_proj.weight", kv_t),
+                "o_proj": stack(ids, p + ".layers.{i}.self_attn.o_proj.weight", o_t),
+                "post_norm": stack(
+                    ids, p + ".layers.{i}.post_attention_layernorm.weight",
+                    np.asarray),
+            }
+
+        out: Dict[str, Any] = {
+            "embed": _vpad(get(p + ".embed_tokens.weight"), spec.padded_vocab),
+            "final_norm": get(p + ".norm.weight"),
+        }
+        if not spec.tie_word_embeddings:
+            out["lm_head"] = np.ascontiguousarray(
+                _vpad(get("lm_head.weight"), spec.padded_vocab).T)
+
+        if dense_ids:
+            dense = attn_stack(dense_ids)
+            dense.update({
+                "gate_proj": stack(dense_ids,
+                                   p + ".layers.{i}.feed_forward.gate_proj.weight", t),
+                "up_proj": stack(dense_ids,
+                                 p + ".layers.{i}.feed_forward.up_proj.weight", t),
+                "down_proj": stack(dense_ids,
+                                   p + ".layers.{i}.feed_forward.down_proj.weight", t),
+            })
+            out["layers"] = dense
+
+        if moe_ids:
+            moe = attn_stack(moe_ids)
+            # HF stores experts FUSED: gate_up_proj (E, H, 2I) and
+            # down_proj (E, I, H) as parameters (already (in, out))
+            gate_up = np.stack([get(
+                p + f".layers.{i}.feed_forward.experts.gate_up_proj")
+                for i in moe_ids])                        # (Lm, E, H, 2I)
+            I = spec.moe.intermediate_size
+            moe.update({
+                "router": np.stack([t(get(
+                    p + f".layers.{i}.feed_forward.router.weight")).astype(
+                    np.float32) for i in moe_ids]),
+                "expert_gate": np.ascontiguousarray(gate_up[..., :I]),
+                "expert_up": np.ascontiguousarray(gate_up[..., I:]),
+                "expert_down": np.stack([get(
+                    p + f".layers.{i}.feed_forward.experts.down_proj")
+                    for i in moe_ids]),
+                "shared_gate": stack(
+                    moe_ids,
+                    p + ".layers.{i}.feed_forward.shared_expert.gate_proj.weight", t),
+                "shared_up": stack(
+                    moe_ids,
+                    p + ".layers.{i}.feed_forward.shared_expert.up_proj.weight", t),
+                "shared_down": stack(
+                    moe_ids,
+                    p + ".layers.{i}.feed_forward.shared_expert.down_proj.weight", t),
+            })
+            out["moe_layers"] = moe
+        return out
+
+    @classmethod
+    def load_hf_model(cls, model_path: str):
+        from transformers import Llama4ForCausalLM
+        return Llama4ForCausalLM.from_pretrained(model_path)
+
+
+def _vpad(w: np.ndarray, padded: int) -> np.ndarray:
+    if w.shape[0] < padded:
+        w = np.pad(w, [(0, padded - w.shape[0])] + [(0, 0)] * (w.ndim - 1))
+    return w
+
+
+# ---------------------------------------------------------------------------
+# Vision tower (reference: models/llama4/modeling_llama4_vision.py, 1214 LoC
+# — unfold-conv patch embed, 2-D rope over the patch grid + a zero-angle CLS
+# slot appended LAST, pre/post LayerNorm ViT, pixel-shuffle adapter) and the
+# multimodal projector feeding image_embeds into the shared prefill merge.
+# ---------------------------------------------------------------------------
+
+def llama4_vision_rope_tables(image_size: int, patch_size: int,
+                              hidden: int, heads: int,
+                              theta: float = 10000.0):
+    """cos/sin (P+1, head_dim/2) for the 2-D vision rope (HF
+    Llama4VisionRotaryEmbedding semantics: interleaved x/y frequency slots,
+    angles zeroed on the CLS slot)."""
+    idx = image_size // patch_size
+    img_idx = np.arange(idx * idx, dtype=np.int32).reshape(-1, 1)
+    img_idx = np.concatenate([img_idx, img_idx[:1]], axis=0)
+    img_idx[-1, -1] = -2                      # CLS sentinel
+    fx = img_idx % idx
+    fy = img_idx // idx
+    freq_dim = hidden // heads // 2
+    rope_freq = 1.0 / (theta ** (np.arange(0, freq_dim, 2)[: freq_dim // 2]
+                                 .astype(np.float32) / freq_dim))
+    freqs_x = np.repeat((fx + 1)[..., None] * rope_freq[None, None, :], 2,
+                        axis=-1)
+    freqs_y = np.repeat((fy + 1)[..., None] * rope_freq[None, None, :], 2,
+                        axis=-1)
+    freqs = np.concatenate([freqs_x, freqs_y], axis=-1)[..., ::2]
+    freqs = np.where(img_idx.reshape(-1, 1, 1) < 0, 0.0, freqs)[:, 0, :]
+    return np.cos(freqs), np.sin(freqs)       # (P+1, head_dim/2)
+
+
+def _vision_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+                 ) -> jnp.ndarray:
+    """Interleaved-pair rotation (view_as_complex convention): x (B,N,H,D),
+    cos/sin (N, D/2)."""
+    xf = x.astype(jnp.float32)
+    x0, x1 = xf[..., 0::2], xf[..., 1::2]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.stack([x0 * c - x1 * s, x0 * s + x1 * c], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _vis_ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _pixel_shuffle(x: jnp.ndarray, ratio: float) -> jnp.ndarray:
+    """HF llama4 pixel_shuffle: (B, P, C) -> (B, P*r*r, C/(r*r))."""
+    b, p, c = x.shape
+    side = int(math.isqrt(p))
+    x = x.reshape(b, side, side, c)
+    x = x.reshape(b, side, int(side * ratio), int(c / ratio))
+    x = jnp.transpose(x, (0, 2, 1, 3))
+    x = x.reshape(b, int(side * ratio), int(side * ratio),
+                  int(c / (ratio * ratio)))
+    x = jnp.transpose(x, (0, 2, 1, 3))
+    return x.reshape(b, -1, x.shape[-1])
+
+
+def llama4_vision_forward(vcfg: Dict[str, Any], params: Dict[str, Any],
+                          pixel_values: jnp.ndarray) -> jnp.ndarray:
+    """pixel_values (B, C, H, W) -> per-image features
+    (B, P*ratio^2, projector_output_dim) — HF Llama4VisionModel.forward."""
+    b = pixel_values.shape[0]
+    p = vcfg["patch_size"]
+    hidden = vcfg["hidden_size"]
+    # unfold-conv patch embed: (B,C,H,W) -> (B, P, C*p*p) @ W
+    x = pixel_values.reshape(b, -1, vcfg["image_size"] // p, p,
+                             vcfg["image_size"] // p, p)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(
+        b, (vcfg["image_size"] // p) ** 2, -1)
+    x = x @ params["patch_proj"]
+    # CLS appended LAST (HF cat([patches, class_embedding]))
+    cls = jnp.broadcast_to(params["class_embedding"][None, None, :],
+                           (b, 1, hidden))
+    x = jnp.concatenate([x, cls], axis=1)
+    x = x + params["pos_embed"]
+    x = _vis_ln(x, params["ln_pre_w"], params["ln_pre_b"])
+
+    nh = vcfg["num_heads"]
+    hd = hidden // nh
+    cos, sin = params["rope_cos"], params["rope_sin"]
+
+    def body(h, lw):
+        r = _vis_ln(h, lw["ln1_w"], lw["ln1_b"])
+        n = h.shape[1]
+        q = (r @ lw["q"] + lw["q_b"]).reshape(b, n, nh, hd)
+        k = (r @ lw["k"] + lw["k_b"]).reshape(b, n, nh, hd)
+        v = (r @ lw["v"] + lw["v_b"]).reshape(b, n, nh, hd)
+        q = _vision_rope(q, cos, sin)
+        k = _vision_rope(k, cos, sin)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhqk,bkhd->bqhd", pr, v.astype(jnp.float32))
+        h = h + (a.reshape(b, n, -1).astype(h.dtype) @ lw["o"] + lw["o_b"])
+        r = _vis_ln(h, lw["ln2_w"], lw["ln2_b"])
+        m = jax.nn.gelu(r @ lw["fc1"] + lw["fc1_b"], approximate=False)
+        h = h + (m @ lw["fc2"] + lw["fc2_b"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _vis_ln(x, params["ln_post_w"], params["ln_post_b"])
+    x = x[:, :-1, :]                          # drop CLS
+    # pixel-shuffle adapter (Llama4VisionPixelShuffleMLP + MLP2: gelu after
+    # BOTH projections)
+    x = _pixel_shuffle(x, vcfg["pixel_shuffle_ratio"])
+    x = jax.nn.gelu(x @ params["adapter_fc1"], approximate=False)
+    x = jax.nn.gelu(x @ params["adapter_fc2"], approximate=False)
+    return x
+
+
+def convert_llama4_vision(sd: Dict[str, np.ndarray], vcfg: Dict[str, Any],
+                          prefix: str = "vision_model") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    L = vcfg["num_layers"]
+
+    def lw(i):
+        b = f"model.layers.{i}"
+        return {
+            "ln1_w": get(f"{b}.input_layernorm.weight"),
+            "ln1_b": get(f"{b}.input_layernorm.bias"),
+            "ln2_w": get(f"{b}.post_attention_layernorm.weight"),
+            "ln2_b": get(f"{b}.post_attention_layernorm.bias"),
+            "q": t(get(f"{b}.self_attn.q_proj.weight")),
+            "q_b": get(f"{b}.self_attn.q_proj.bias"),
+            "k": t(get(f"{b}.self_attn.k_proj.weight")),
+            "k_b": get(f"{b}.self_attn.k_proj.bias"),
+            "v": t(get(f"{b}.self_attn.v_proj.weight")),
+            "v_b": get(f"{b}.self_attn.v_proj.bias"),
+            "o": t(get(f"{b}.self_attn.o_proj.weight")),
+            "o_b": get(f"{b}.self_attn.o_proj.bias"),
+            "fc1": t(get(f"{b}.mlp.fc1.weight")),
+            "fc1_b": get(f"{b}.mlp.fc1.bias"),
+            "fc2": t(get(f"{b}.mlp.fc2.weight")),
+            "fc2_b": get(f"{b}.mlp.fc2.bias"),
+        }
+
+    layers = [lw(i) for i in range(L)]
+    cos, sin = llama4_vision_rope_tables(
+        vcfg["image_size"], vcfg["patch_size"], vcfg["hidden_size"],
+        vcfg["num_heads"], vcfg.get("rope_theta", 10000.0))
+    return {
+        "patch_proj": t(get("patch_embedding.linear.weight")),
+        "class_embedding": get("class_embedding"),
+        "pos_embed": get("positional_embedding_vlm"),
+        "ln_pre_w": get("layernorm_pre.weight"),
+        "ln_pre_b": get("layernorm_pre.bias"),
+        "ln_post_w": get("layernorm_post.weight"),
+        "ln_post_b": get("layernorm_post.bias"),
+        "adapter_fc1": t(get("vision_adapter.mlp.fc1.weight")),
+        "adapter_fc2": t(get("vision_adapter.mlp.fc2.weight")),
+        "rope_cos": np.asarray(cos, np.float32),
+        "rope_sin": np.asarray(sin, np.float32),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+    }
+
+
+class Llama4VLApplication:
+    """Image-to-text llama4 (reference: Llama4ForConditionalGeneration /
+    modeling_llama4.py + the image-to-text base,
+    models/image_to_text_model_base.py): vision tower + linear projector +
+    the shared multimodal prefill merge of CausalLMApplication."""
+
+    def __init__(self, model_path: Optional[str], config, mesh=None):
+        from ..application import CausalLMApplication
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        family=Llama4Family, mesh=mesh)
+        self.image_token_index = int(getattr(config, "image_token_index",
+                                             getattr(config, "image_token_id",
+                                                     0)))
+        vc = dict(config.vision_config)
+        self.vcfg = {
+            "image_size": int(vc["image_size"]),
+            "patch_size": int(vc["patch_size"]),
+            "hidden_size": int(vc["hidden_size"]),
+            "num_heads": int(vc["num_attention_heads"]),
+            "num_layers": int(vc["num_hidden_layers"]),
+            "pixel_shuffle_ratio": float(vc.get("pixel_shuffle_ratio", 0.5)),
+            "rope_theta": float(vc.get("rope_theta", 10000.0)),
+        }
+        self.vision_params = None
+        self.projector = None
+        self._vis_fn = jax.jit(partial(llama4_vision_forward, self.vcfg))
+
+    def load_weights(self):
+        from ...utils import checkpoint as ckpt
+        sd = ckpt.load_state_dict(self.model_path)
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+                continue
+            for pre in ("model.language_model.", "language_model.model.",
+                        "language_model."):
+                if k.startswith(pre):
+                    text_sd["model." + k[len(pre):]] = v
+                    break
+        host = Llama4Family.convert_hf_state_dict(text_sd, self.text.spec)
+        self.text._put_params(host)
+        vis_prefix = ("model.vision_model" if any(
+            k.startswith("model.vision_model") for k in sd)
+            else "vision_model")
+        self.vision_params = jax.tree.map(
+            jnp.asarray, convert_llama4_vision(sd, self.vcfg, vis_prefix))
+        proj = ("model.multi_modal_projector" if any(
+            k.startswith("model.multi_modal_projector") for k in sd)
+            else "multi_modal_projector")
+        self.projector = jnp.asarray(np.ascontiguousarray(
+            np.asarray(sd[f"{proj}.linear_1.weight"], np.float32).T))
+        self.text.init_cache()
+        return self
+
+    def encode_images(self, pixel_values: np.ndarray) -> jnp.ndarray:
+        """(N_img, C, H, W) -> (N_img, tokens_per_image, H_text)."""
+        feats = self._vis_fn(self.vision_params,
+                             jnp.asarray(pixel_values, jnp.float32))
+        return feats @ self.projector
+
+    def generate(self, input_ids: np.ndarray, pixel_values: np.ndarray,
+                 max_new_tokens: int = 16, **kw):
+        """input_ids contain image_token_index placeholders (one per image
+        feature position, HF processor layout)."""
+        input_ids = np.asarray(input_ids)
+        feats = self.encode_images(pixel_values)
+        n_img, tpi, hdim = feats.shape
+        image_mask = input_ids == self.image_token_index
+        embeds = feats.reshape(1, n_img * tpi, hdim)
+        embeds = jnp.broadcast_to(embeds, (input_ids.shape[0],) + embeds.shape[1:])
+        return self.text.generate(input_ids.astype(np.int32),
+                                  image_embeds=embeds,
+                                  image_mask=image_mask,
+                                  max_new_tokens=max_new_tokens, **kw)

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...config import InferenceConfig, TpuConfig
-from ...modules.kv_cache import KVCacheSpec, init_cache
+from ...modules.kv_cache import KVCacheSpec, cache_len_of, init_cache
 from ...ops import attention as attn_ops
 from ...ops import sampling as sampling_ops
 from ...ops.normalization import rms_norm
@@ -221,13 +221,13 @@ def mllama_forward(spec: DecoderSpec, mspec: MllamaSpec, tcfg: TpuConfig,
     padded window. phase "decode": T=1 over the self cache."""
     if phase == "prefill":
         ai = attn_inputs(spec, position_ids,
-                         lambda w: attn_ops.prefill_causal_mask(
-                             input_ids.shape[1], position_ids, window=w))
+                         lambda w, c=0: attn_ops.prefill_causal_mask(
+                             input_ids.shape[1], position_ids, window=w, chunk=c))
     else:
-        cache_len = cache["k"].shape[2]
+        cache_len = cache_len_of(cache)
         ai = attn_inputs(spec, position_ids,
-                         lambda w: attn_ops.decode_mask(position_ids,
-                                                        cache_len, window=w))
+                         lambda w, c=0: attn_ops.decode_mask(position_ids,
+                                                        cache_len, window=w, chunk=c))
     hidden = _embed(spec, params, input_ids)
     kf, vf = cache["k"], cache["v"]
     si = ci = 0
@@ -313,7 +313,9 @@ class MllamaApplication:
                     text_sd[k] = v
                 elif k.startswith("model.") and ".layers." not in k:
                     text_sd[k] = v
-        host = MllamaTextFamily.convert_hf_state_dict(text_sd, self.spec)
+        from .. import model_base
+        host = model_base.fuse_qkv_host(
+            MllamaTextFamily.convert_hf_state_dict(text_sd, self.spec))
         cross_ids = sorted(
             int(c) for c in self.text_config.cross_attention_layers)
         host["cross_layers"] = convert_cross_layers(text_sd, self.spec,

@@ -121,6 +121,22 @@ class DecoderSpec:
     # None = uniform (sliding_window, if set, applies to every layer).
     layer_pattern: Optional[Tuple[bool, ...]] = None
     local_rope: Optional[RopeConfig] = None   # rope for local layers
+    # llama4 attention variations (reference: models/llama4/
+    # modeling_llama4_text.py — chunked attention + NoPE layers):
+    # local layers use CHUNKED attention (block-diagonal causal over
+    # attention_chunk_size) instead of a sliding window
+    attn_chunk: int = 0
+    # global layers are NoPE: no rotary applied (no_rope_layers)
+    nope_global: bool = False
+    # weightless L2 q/k norm AFTER rope, on rope (local) layers only
+    qk_l2_norm: bool = False
+    # attention temperature tuning on NoPE layers (floor_scale, attn_scale):
+    # q *= log1p(floor((pos+1)/floor_scale)) * attn_scale + 1
+    attn_temp: Optional[Tuple[float, float]] = None
+    # interleaved dense/MoE stacks (llama4 interleave_moe_layer_step):
+    # pattern[i] True = layer i is MoE; params then hold a "layers" dense
+    # stack and a "moe_layers" stack, walked in contiguous runs
+    moe_pattern: Optional[Tuple[bool, ...]] = None
     # gemma3 sandwich norms: post_attn_norm on attention output and
     # post_ff_norm on MLP output, in addition to the two pre-norms
     sandwich_norm: bool = False
@@ -136,8 +152,10 @@ class DecoderSpec:
     # prefill when ops/flash_attention.supports() holds; XLA path otherwise
     flash_prefill: bool = False
     # fused Pallas decode attention (reference analog: attention_block_tkg
-    # TKG kernel, attention_base.py:1186-1382); admission checked per-phase
-    decode_kernel: bool = False
+    # TKG kernel, attention_base.py:1186-1382). Tri-state: None = auto
+    # (cost-model admission in _layer_body — on for window/sink geometries),
+    # True = always when supports() holds, False = never.
+    decode_kernel: Optional[bool] = None
     # MoE: when set, the MLP block is a routed mixture of experts
     # (reference: modules/moe_v2.py; intermediate_size then refers to the
     # per-expert intermediate)
@@ -235,16 +253,20 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
             m.kv_lora_rank, nh * (m.qk_nope_head_dim + m.v_head_dim), dt, True, L)
         layers["o_proj"] = row_parallel(nh * m.v_head_dim, H, dt, True, L)
     else:
+        # q/k/v fused into ONE stacked weight: a decode step is a GEMV per
+        # weight — one (H, q+2kv) matmul streams the bytes at a higher
+        # effective bandwidth than three separate ones (fewer fusion
+        # boundaries; measured on v5e). The reference fuses the same way
+        # (fused_qkv, modules/attention/gqa.py GroupQueryAttention_QKV).
         layers.update({
-            "q_proj": column_parallel(H, spec.q_size, dt, True, L),
-            "k_proj": column_parallel(H, spec.kv_size, dt, True, L),
-            "v_proj": column_parallel(H, spec.kv_size, dt, True, L),
+            "qkv_proj": column_parallel(H, spec.q_size + 2 * spec.kv_size,
+                                        dt, True, L),
             "o_proj": row_parallel(spec.q_size, H, dt, True, L),
         })
         if spec.qkv_bias:
-            layers["q_bias"] = ParamSpec((L, spec.q_size), P(None, AXIS_MP), dt, "zeros")
-            layers["k_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
-            layers["v_bias"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP), dt, "zeros")
+            layers["qkv_bias"] = ParamSpec(
+                (L, spec.q_size + 2 * spec.kv_size), P(None, AXIS_MP), dt,
+                "zeros")
         if spec.qk_norm:
             layers["q_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
             layers["k_norm"] = ParamSpec((L, spec.head_dim), P(), dt, "ones")
@@ -354,6 +376,18 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         moe.update(_moe_param_specs(spec, n_moe))
         out["layers"] = dense
         out["moe_layers"] = moe
+    elif spec.moe is not None and spec.moe_pattern is not None:
+        # interleaved dense/MoE (llama4): stacks hold each kind's layers in
+        # order of appearance; run_layers walks the pattern
+        n_moe = sum(spec.moe_pattern)
+        n_dense = L - n_moe
+        moe = _attn_param_specs(spec, n_moe)
+        moe.update(_moe_param_specs(spec, n_moe))
+        out["moe_layers"] = moe
+        if n_dense:
+            dense = _attn_param_specs(spec, n_dense)
+            dense.update(_dense_mlp_param_specs(spec, n_dense))
+            out["layers"] = dense
     else:
         layers = _attn_param_specs(spec, L)
         layers.update(_dense_mlp_param_specs(spec, L) if spec.moe is None
@@ -395,6 +429,29 @@ def init_params(spec: DecoderSpec, key: jax.Array,
     synthetic weights — reference: modules/checkpoint.py:202-287 random
     N-layer checkpoint creation)."""
     return init_param_tree(decoder_param_specs(spec), key, mesh)
+
+
+def fuse_qkv_host(host: Dict[str, Any]) -> Dict[str, Any]:
+    """Fuse per-projection q/k/v host weights (family converters emit them
+    separately, matching the HF checkpoint) into the stacked ``qkv_proj`` /
+    ``qkv_bias`` the layer graph consumes. Walks the decoder-layer subtrees
+    only — cross-attention ("cross_layers") and vision params keep their own
+    layouts. No-op when already fused (pre-fused quantized checkpoints)."""
+    for key in ("layers", "moe_layers"):
+        d = host.get(key)
+        # MLA layers (deepseek) have a bare q_proj with separate compressed
+        # kv projections — only fuse the standard q/k/v triple
+        if (not isinstance(d, dict) or "q_proj" not in d
+                or "k_proj" not in d or "v_proj" not in d):
+            continue
+        d["qkv_proj"] = np.concatenate(
+            [np.asarray(d.pop("q_proj")), np.asarray(d.pop("k_proj")),
+             np.asarray(d.pop("v_proj"))], axis=-1)
+        if "q_bias" in d:
+            d["qkv_bias"] = np.concatenate(
+                [np.asarray(d.pop("q_bias")), np.asarray(d.pop("k_bias")),
+                 np.asarray(d.pop("v_bias"))], axis=-1)
+    return host
 
 
 def param_shardings(spec: DecoderSpec, mesh: Mesh):
@@ -460,22 +517,26 @@ def attn_inputs(spec: DecoderSpec, position_ids, make_mask,
                 rope_positions=None) -> Dict[str, Any]:
     """Bundle rope cos/sin + attention mask(s) for the layer stack.
 
-    ``make_mask(window)`` builds the phase-appropriate mask. With a
+    ``make_mask(window, chunk)`` builds the phase-appropriate mask. With a
     ``layer_pattern`` set (alternating local/global layers — reference:
-    gemma3 / gpt_oss families), both the local variant (sliding window +
-    local_rope) and the global variant are built once here; each scanned
+    gemma3 / gpt_oss / llama4 families), both the local variant (sliding
+    window or chunked attention + local_rope) and the global variant
+    (optionally NoPE — identity rotation) are built once here; each scanned
     layer selects by its is_local flag — one compiled layer body, no
     per-layer branching (SURVEY §2.7)."""
     rp = rope_positions if rope_positions is not None else position_ids
     cos, sin = rope_cos_sin(rp, spec.rope)
     ai: Dict[str, Any] = {"cos": cos, "sin": sin}
     if spec.layer_pattern is None:
-        ai["mask"] = make_mask(spec.sliding_window)
+        ai["mask"] = make_mask(spec.sliding_window, spec.attn_chunk)
         return ai
-    ai["mask"] = make_mask(0)
+    ai["mask"] = make_mask(0, 0)
     cos_l, sin_l = rope_cos_sin(rp, spec.local_rope or spec.rope)
+    if spec.nope_global:
+        # llama4 NoPE global layers: identity rotation
+        ai["cos"], ai["sin"] = jnp.ones_like(cos), jnp.zeros_like(sin)
     ai["cos_l"], ai["sin_l"] = cos_l, sin_l
-    ai["mask_l"] = make_mask(spec.sliding_window)
+    ai["mask_l"] = make_mask(spec.sliding_window, spec.attn_chunk)
     return ai
 
 
@@ -485,7 +546,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
-                adapter_ids=None, replace=None):
+                adapter_ids=None, replace=None, kv_view: int = None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -537,16 +598,14 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     if spec.mla is not None:
         q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
     else:
-        q = apply_lora(spec.lora, layer_w, "q_proj", h,
-                       qlinear(h, layer_w["q_proj"]), adapter_ids)
-        k = apply_lora(spec.lora, layer_w, "k_proj", h,
-                       qlinear(h, layer_w["k_proj"]), adapter_ids)
-        v = apply_lora(spec.lora, layer_w, "v_proj", h,
-                       qlinear(h, layer_w["v_proj"]), adapter_ids)
+        qkv = qlinear(h, layer_w["qkv_proj"])
         if spec.qkv_bias:
-            q = q + layer_w["q_bias"]
-            k = k + layer_w["k_bias"]
-            v = v + layer_w["v_bias"]
+            qkv = qkv + layer_w["qkv_bias"]
+        q, k, v = jnp.split(qkv, [spec.q_size, spec.q_size + spec.kv_size],
+                            axis=-1)
+        q = apply_lora(spec.lora, layer_w, "q_proj", h, q, adapter_ids)
+        k = apply_lora(spec.lora, layer_w, "k_proj", h, k, adapter_ids)
+        v = apply_lora(spec.lora, layer_w, "v_proj", h, v, adapter_ids)
         if spec.qk_norm_full:
             # olmo2: RMSNorm over the whole projection, pre head-split
             q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
@@ -568,6 +627,29 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         q = apply_rope(q, cos, sin, interleaved=spec.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=spec.rope_interleaved)
+        if spec.qk_l2_norm:
+            # llama4: weightless L2 norm AFTER rope, rope (local) layers only
+            def _l2(x):
+                xf = x.astype(jnp.float32)
+                n = xf * jax.lax.rsqrt(
+                    jnp.mean(xf * xf, axis=-1, keepdims=True) + spec.rms_eps)
+                return n.astype(x.dtype)
+            if spec.layer_pattern is not None:
+                q = jnp.where(is_local, _l2(q), q)
+                k = jnp.where(is_local, _l2(k), k)
+            else:
+                q, k = _l2(q), _l2(k)
+        if spec.attn_temp is not None:
+            # llama4 NoPE temperature tuning (reference:
+            # modeling_llama4_text.py attn_temperature_tuning; HF
+            # attn_scales = log1p(floor((pos+1)/floor_scale))*scale + 1)
+            floor_scale, a_scale = spec.attn_temp
+            pos_f = positions.astype(jnp.float32)
+            scales = (jnp.log1p(jnp.floor((pos_f + 1.0) / floor_scale))
+                      * a_scale + 1.0)[:, :, None, None]
+            q_t = (q.astype(jnp.float32) * scales).astype(q.dtype)
+            q = jnp.where(is_local, q, q_t) \
+                if spec.layer_pattern is not None else q_t
 
     if phase == "paged":
         from ..modules import block_kv_cache as bkv
@@ -578,10 +660,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale), li,
             slot_mapping)
         k_all = kv.dequantize_kv(
-            bkv.gather_block_kv(kv.read_layer(k_full, li), block_table),
+            bkv.gather_block_kv(bkv.read_layer(k_full, li), block_table),
             dtype, spec.kv_scale)
         v_all = kv.dequantize_kv(
-            bkv.gather_block_kv(kv.read_layer(v_full, li), block_table),
+            bkv.gather_block_kv(bkv.read_layer(v_full, li), block_table),
             dtype, spec.kv_scale)
         attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                 logits_soft_cap=spec.attn_soft_cap, sink=sink)
@@ -607,37 +689,68 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                                     sink=sink)
         k_full = kv.write_prefill_at_layer(
             k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
-            li, seq_ids)
+            li, seq_ids,
+            identity_seq_ids=identity_seq_ids and arange_positions,
+            k_transposed=True)
         v_full = kv.write_prefill_at_layer(
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
-            li, seq_ids)
+            li, seq_ids,
+            identity_seq_ids=identity_seq_ids and arange_positions)
     else:
         k_full = kv.write_tokens_at_layer(
             k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
-            li, seq_ids, positions)
+            li, seq_ids, positions, k_transposed=True)
         v_full = kv.write_tokens_at_layer(
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
             li, seq_ids, positions)
-        if (spec.decode_kernel and decode_attention.supports(spec, hidden.shape[1])
-                and identity_seq_ids and hidden.shape[0] == k_full.shape[1]
-                and spec.kv_scale is None and k_full.dtype == dtype
-                and spec.gqa.tp == 1 and not spec.flash_decoding):
+        use_kernel = (spec.decode_kernel is not False
+                      and decode_attention.supports(spec, hidden.shape[1])
+                      and identity_seq_ids
+                      and hidden.shape[0] == k_full.shape[1]
+                      and spec.kv_scale is None and k_full.dtype == dtype
+                      and not spec.flash_decoding)
+        if use_kernel and spec.decode_kernel is None:
+            # auto admission (reference analog: flash-strategy heuristics,
+            # attention_base.py:985-1034): the kernel wins where the XLA
+            # path must stream cache slots the mask discards anyway —
+            # sliding-window / alternating-local patterns and learned-sink
+            # softmax (XLA's sink path pays a concat + second softmax).
+            # Plain full attention with kv_view-bucketed reads measured
+            # FASTER on the XLA path (v5e: 0.148 vs 0.231 ms/step at
+            # S=1024 full-live), so auto keeps it off there.
+            use_kernel = (spec.attn_sink or spec.sliding_window > 0
+                          or spec.layer_pattern is not None)
+        if use_kernel:
             # fused Pallas decode attention over the stacked cache: reads
             # only the live prefix of each row (DMA block elision) and folds
             # the active token in-registers — the cache row written above is
-            # masked out (kpos < pos), so write order is irrelevant
+            # masked out (kpos < pos), so write order is irrelevant.
+            # dispatch() shard_maps over the mesh's dp/mp axes for tp>1.
             if spec.layer_pattern is not None:
                 win = jnp.where(is_local, spec.sliding_window, 0)
             else:
                 win = jnp.asarray(spec.sliding_window, jnp.int32)
-            attn_out = decode_attention.decode_attention_stacked(
+            kernel_out = decode_attention.dispatch(
                 q[:, 0], k_full, v_full, k[:, 0], v[:, 0], li,
                 positions[:, 0], scale=spec.scale, window=win,
                 soft_cap=spec.attn_soft_cap, sink=sink,
-                interpret=jax.default_backend() != "tpu")[:, None]
-        else:
-            k_layer = kv.read_layer(k_full, li)
-            v_layer = kv.read_layer(v_full, li)
+                interpret=jax.default_backend() != "tpu")
+            if kernel_out is None:        # heads not shardable on this mesh
+                use_kernel = False
+            else:
+                attn_out = kernel_out[:, None]
+        if not use_kernel:
+            # native-layout reads: K transposed (B, H, D, S), V (B, H, S,
+            # D) — each attention einsum contracts its operand in place
+            # (any shared layout costs a materialized relayout of the live
+            # cache per layer per step)
+            k_layer = kv.read_layer_hl(k_full, li)       # (B, H, D, S)
+            v_layer = kv.read_layer_hl(v_full, li)       # (B, H, S, D)
+            if kv_view is not None and kv_view < v_layer.shape[2]:
+                # decode seq bucket: read only the live prefix (the mask is
+                # built against the same kv_view length)
+                k_layer = k_layer[:, :, :, :kv_view]
+                v_layer = v_layer[:, :, :kv_view]
             if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
                 # static guarantee that seq_ids == arange (no continuous
                 # batching): skip the row-gather copy of the whole cache
@@ -650,9 +763,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 v_all = kv.dequantize_kv(
                     kv.gather_cache_rows(v_layer, seq_ids), dtype,
                     spec.kv_scale)
-            attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
-                                    logits_soft_cap=spec.attn_soft_cap,
-                                    sink=sink)
+            attn_out = attn_ops.mha_hl(q, k_all, v_all, mask, spec.scale,
+                                       logits_soft_cap=spec.attn_soft_cap,
+                                       sink=sink)
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
@@ -694,7 +807,7 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                identity_seq_ids: bool = False,
                arange_positions: bool = False,
                slot_mapping=None, block_table=None,
-               adapter_ids=None, replacements=None):
+               adapter_ids=None, replacements=None, kv_view: int = None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -715,7 +828,7 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
               identity_seq_ids=identity_seq_ids,
               arange_positions=arange_positions, slot_mapping=slot_mapping,
               block_table=block_table, adapter_ids=adapter_ids,
-              replacements=replacements)
+              replacements=replacements, kv_view=kv_view)
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans carrying one contiguous cache
@@ -732,6 +845,37 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
         caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
         return hidden, {"k": kf, "v": vf}, caps
 
+    if spec.moe is not None and spec.moe_pattern is not None:
+        # interleaved dense/MoE stacks (llama4 interleave_moe_layer_step):
+        # walk contiguous runs of equal kind; cache layer index stays the
+        # absolute layer position
+        pat = spec.moe_pattern
+        L = spec.num_layers
+        runs = []
+        s0 = 0
+        for i in range(1, L + 1):
+            if i == L or pat[i] != pat[s0]:
+                runs.append((s0, i - s0, pat[s0]))
+                s0 = i
+        stack_pos = {"dense": 0, "moe": 0}
+        kf, vf = cache["k"], cache["v"]
+        caps_parts = []
+        for start, count, is_moe in runs:
+            kind = "moe" if is_moe else "dense"
+            stack = params["moe_layers" if is_moe else "layers"]
+            j0 = stack_pos[kind]
+            stack_pos[kind] += count
+            seg = jax.tree.map(lambda a: a[j0:j0 + count], stack)
+            hidden, kf, vf, c = run_layer_slice(
+                spec, seg, kf, vf, hidden, ai, cache_offset=start,
+                is_local=is_local[start:start + count],
+                rep=sl(start, start + count), mlp_kind=kind, **kw)
+            caps_parts.append(c)
+        caps = ({k: jnp.concatenate([c[k] for c in caps_parts])
+                 for k in caps_parts[0]} if caps_parts and caps_parts[0]
+                else {})
+        return hidden, {"k": kf, "v": vf}, caps
+
     L = spec.num_layers
     hidden, kf, vf, caps = run_layer_slice(
         spec, params["layers"], cache["k"], cache["v"], hidden, ai,
@@ -744,12 +888,38 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                     seq_ids, positions, phase,
                     identity_seq_ids=False, arange_positions=False,
                     slot_mapping=None, block_table=None, adapter_ids=None,
-                    replacements=None):
-    """Scan one contiguous run of stacked layers against the full cache
+                    replacements=None, kv_view=None):
+    """Run one contiguous run of stacked layers against the full cache
     (cache layer index = scan index + ``cache_offset``). Exposed so families
     with interleaved non-standard layers (mllama cross-attention decoder)
-    can stitch standard segments around their own blocks."""
+    can stitch standard segments around their own blocks.
+
+    Decode (T = 1) UNROLLS the layer loop instead of scanning: with a
+    static layer index, each layer's cache read is a lazily-fused static
+    slice; under lax.scan the dynamic layer index forces XLA to
+    MATERIALIZE every layer's cache slice (plus a relayout copy for the
+    attention dot) every step — measured ~0.25 ms/step of pure copy
+    traffic on v5e at B=2/S=1024/16 layers. Prefill keeps the scan (one
+    compiled body, O(1) compile time in depth; the per-layer copies are
+    amortized over the whole window there)."""
     n = jax.tree.leaves(layer_params)[0].shape[0]
+
+    if phase == "decode" and jax.tree.leaves(hidden)[0].shape[1] == 1:
+        caps_list = []
+        for i in range(n):
+            layer_w = jax.tree.map(lambda a: a[i], layer_params)
+            hidden, kf, vf, caps_i = _layer_body(
+                spec, hidden, layer_w, kf, vf, i + cache_offset, ai,
+                is_local[i], seq_ids, positions, phase, identity_seq_ids,
+                arange_positions, slot_mapping, block_table, mlp_kind,
+                adapter_ids,
+                (jax.tree.map(lambda a: a[i], rep)
+                 if replacements is not None else None),
+                kv_view=kv_view)
+            caps_list.append(caps_i)
+        caps = ({k: jnp.stack([c[k] for c in caps_list])
+                 for k in caps_list[0]} if caps_list and caps_list[0] else {})
+        return hidden, kf, vf, caps
 
     def body(carry, xs):
         h, k_, v_ = carry
@@ -758,7 +928,7 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
             spec, h, layer_w, k_, v_, li + cache_offset, ai, loc, seq_ids,
             positions, phase, identity_seq_ids, arange_positions,
             slot_mapping, block_table, mlp_kind, adapter_ids,
-            rp if replacements is not None else None)
+            rp if replacements is not None else None, kv_view=kv_view)
         return (h, k_, v_), caps
 
     (hidden, kf, vf), caps = jax.lax.scan(
@@ -805,8 +975,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     model_base.py:1374-1387).
     Returns dict(tokens (B,), last_logits (B, V) [optional], cache).
     """
-    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.prefill_causal_mask(
-        input_ids.shape[1], position_ids, window=w),
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.prefill_causal_mask(
+        input_ids.shape[1], position_ids, window=w, chunk=c),
         rope_positions=rope_position_ids)
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
@@ -824,11 +994,11 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         hidden = _shard(hidden, AXIS_DP, AXIS_CP, None)
     # context_encoding_step always feeds arange positions per row (the host
     # shim builds them); chunked/offset prefill variants must pass False
-    hidden, new_cache, caps = run_layers(spec, params, cache, hidden, ai,
-                                         seq_ids, position_ids, "prefill",
-                                         arange_positions=True,
-                                         adapter_ids=adapter_ids,
-                                         replacements=replacements)
+    hidden, new_cache, caps = run_layers(
+        spec, params, cache, hidden, ai, seq_ids, position_ids, "prefill",
+        identity_seq_ids=not tpu_cfg.is_continuous_batching,
+        arange_positions=True, adapter_ids=adapter_ids,
+        replacements=replacements)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -851,23 +1021,28 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids,
                           sampling_params, rng, adapter_ids=None,
-                          replacements=None, rope_position_ids=None):
+                          replacements=None, rope_position_ids=None,
+                          kv_view: int = None):
     """Decode graph (reference submodel tag ``token_generation_model``).
 
     input_ids (B, T) with T = 1 (or speculation window).
     rope_position_ids (B, T, 3): optional M-RoPE 3-axis positions
     (reference: qwen2_vl rotary_position_ids plumbing,
     models/model_base.py:566-578).
+    kv_view: static decode seq bucket — the graph READS only cache slots
+    [0, kv_view), so early decode streams a fraction of the allocated cache
+    (reference: TKG seq buckets, autobucketing.py:226; decode is HBM-bound
+    so this is a direct throughput win). Writes still address the full cache.
     """
-    cache_len = cache["k"].shape[2]
-    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
-        position_ids, cache_len, window=w),
+    cache_len = kv_view or kv.cache_len_of(cache)
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
+        position_ids, cache_len, window=w, chunk=c),
         rope_positions=rope_position_ids)
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
         identity_seq_ids=not tpu_cfg.is_continuous_batching,
-        adapter_ids=adapter_ids, replacements=replacements)
+        adapter_ids=adapter_ids, replacements=replacements, kv_view=kv_view)
     logits = _lm_head(spec, params, hidden)
     out = {"cache": new_cache}
     if caps:
@@ -886,9 +1061,9 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     scoring all candidate tokens, model_base.py:2617-2642). Within-step
     causality falls out of the cache-write-then-attend order plus the
     position mask."""
-    cache_len = cache["k"].shape[2]
-    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
-        position_ids, cache_len, window=w))
+    cache_len = kv.cache_len_of(cache)
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
+        position_ids, cache_len, window=w, chunk=c))
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids,
@@ -916,8 +1091,8 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     logits are sampled. Cache layout (L, N_blocks, Bs, Hkv, D).
     """
     kv_len = block_table.shape[1] * cache["k"].shape[2]
-    ai = attn_inputs(spec, position_ids, lambda w: attn_ops.decode_mask(
-        position_ids, kv_len, window=w))
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
+        position_ids, kv_len, window=w, chunk=c))
     hidden = _embed(spec, params, input_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
@@ -935,7 +1110,8 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                 first_tokens, position_ids, seq_ids, sampling_params, rng,
-                num_steps: int, adapter_ids=None, rope_position_ids=None):
+                num_steps: int, adapter_ids=None, rope_position_ids=None,
+                kv_view: int = None):
     """Fused multi-token decode: ``lax.scan`` of ``num_steps`` decode steps in
     ONE device call. This is the TPU answer to the reference's async
     double-buffering (modules/async_execution.py) — instead of hiding the
@@ -954,7 +1130,8 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
             spec, replace_output_logits(tpu_cfg), params, cch,
             tok[:, None], pos[:, None], seq_ids, sampling_params, step_rng,
             adapter_ids,
-            rope_position_ids=rpos[:, None, :] if use_mrope else None)
+            rope_position_ids=rpos[:, None, :] if use_mrope else None,
+            kv_view=kv_view)
         nxt = out["tokens"]
         # text-token M-RoPE positions advance in lockstep on all 3 axes
         return (nxt, pos + 1, rpos + 1 if use_mrope else rpos,
@@ -1048,7 +1225,8 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         # attn_kernel_enabled until it beats XLA (reference keeps the same
         # dual-path structure, attention_base.py:985-1034)
         flash_prefill=bool(tcfg.attn_kernel_enabled),
-        decode_kernel=bool(tcfg.attn_block_tkg_kernel_enabled),
+        # tri-state passthrough (None = auto cost-model admission)
+        decode_kernel=tcfg.attn_block_tkg_kernel_enabled,
         quant=quant_spec_from_config(tcfg),
         lora=lora_spec_from_config(tcfg),
         seq_parallel=bool(tcfg.sequence_parallel_enabled),

@@ -28,6 +28,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..config import TpuConfig
+from ..modules import kv_cache as kv_mod
 from ..modules.token_tree import TokenTree
 from ..ops import attention as attn_ops
 from ..ops.normalization import rms_norm
@@ -237,10 +238,10 @@ def eagle_forward(draft_spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         e = rms_norm(e, params["fc_norm"], draft_spec.rms_eps)
     fused = jnp.concatenate([e, prev_hidden.astype(e.dtype)], axis=-1)
     h0 = fused @ params["fc"]
-    cache_len = cache["k"].shape[2]
+    cache_len = kv_mod.cache_len_of(cache)
     ai = model_base.attn_inputs(
         draft_spec, positions,
-        lambda w: attn_ops.decode_mask(positions, cache_len, window=w))
+        lambda w, c=0: attn_ops.decode_mask(positions, cache_len, window=w, chunk=c))
     hidden, new_cache, _ = model_base.run_layers(
         draft_spec, params, cache, h0, ai, seq_ids, positions, "decode",
         identity_seq_ids=not tpu_cfg.is_continuous_batching)
@@ -301,7 +302,7 @@ def eagle_speculation_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
     # draft cache refresh (reference: final draft cache-update run
     # :2663-2694): slot p gets the verified pair (token at p, target feature
     # at p-1); slots beyond the accepted prefix are pushed out of range
-    cache_len = draft_cache["k"].shape[2]
+    cache_len = kv_mod.cache_len_of(draft_cache)
     hid_seq = jnp.concatenate(
         [prev_hidden[:, None, :], t_out["hidden"][:, :k, :]], axis=1)
     refresh_pos = jnp.where(idx <= n_acc[:, None], cand_pos, cache_len)
@@ -603,7 +604,7 @@ def medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     rpos = base_pos[:, None] + ridx
     # invalid tail slots: push writes out of range (dropped)
     rpos = jnp.where(ridx <= (n_acc + 1)[:, None], rpos,
-                     out["cache"]["k"].shape[2])
+                     kv_mod.cache_len_of(out["cache"]))
     upd = model_base.token_generation_multi(
         spec, tpu_cfg, params, out["cache"], refresh_toks, rpos, seq_ids)
     return {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,

@@ -102,6 +102,15 @@ def write_slots_at_layer(cache: jnp.ndarray, new: jnp.ndarray, layer,
     return flat.reshape(L, n, bs, h, d)
 
 
+def read_layer(cache: jnp.ndarray, layer) -> jnp.ndarray:
+    """Dynamic-slice one layer (N, Bs, H, D) out of the stacked paged cache
+    (the paged layout keeps heads minor — the block gather is row-indexed,
+    not head-sliced, so the contiguous-cache head-leading layout rationale
+    does not apply here)."""
+    return jax.lax.dynamic_index_in_dim(cache, jnp.asarray(layer, jnp.int32),
+                                        0, keepdims=False)
+
+
 def gather_block_kv(cache_layer: jnp.ndarray, block_table: jnp.ndarray
                     ) -> jnp.ndarray:
     """Assemble per-request contiguous KV from the block table.

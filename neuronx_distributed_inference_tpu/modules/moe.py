@@ -71,6 +71,11 @@ class MoESpec:
     # are eligible for expert selection)
     n_group: int = 1
     topk_group: int = 1
+    # llama4 routing: the routing weight scales the expert INPUT
+    # (routed_in = hidden * sigmoid(score); reference llama4 Llama4TextMoe)
+    # instead of the expert output — not equivalent through the gated
+    # nonlinearity, so it is its own mode
+    input_scaled: bool = False
     # TOTAL-token-count (B*T) threshold at or below which the dense
     # all-experts path is used; above it the ragged sorted-grouped-matmul
     # path runs. Decode (B*1 tokens) stays dense up to batch 64 by default.
@@ -153,9 +158,17 @@ def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H); b* optional (E,·) biases."""
     dt = x.dtype
     combine = combine_matrix(moe.num_experts, top_vals, top_idx)  # (B,T,E)
-    # (B,T,E,I): expert axis sharded on ep, intermediate on tp
-    gate = qeinsum("bth,ehi->btei", x, wg)
-    up = qeinsum("bth,ehi->btei", x, wu)
+    if moe.input_scaled:
+        # llama4: scale the expert INPUT by the affinity, combine with 1s
+        xe = (x[:, :, None, :].astype(jnp.float32)
+              * combine[..., None]).astype(dt)          # (B,T,E,H)
+        gate = qeinsum("bteh,ehi->btei", xe, wg)
+        up = qeinsum("bteh,ehi->btei", xe, wu)
+        combine = (combine > 0).astype(jnp.float32)
+    else:
+        # (B,T,E,I): expert axis sharded on ep, intermediate on tp
+        gate = qeinsum("bth,ehi->btei", x, wg)
+        up = qeinsum("bth,ehi->btei", x, wu)
     if bg is not None:
         gate = gate + bg
         up = up + bu
@@ -197,6 +210,11 @@ def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     group_sizes = jnp.bincount(flat_expert, length=moe.num_experts
                                ).astype(jnp.int32)
 
+    if moe.input_scaled:
+        # llama4: affinity scales the expert input; outputs combine with 1s
+        sorted_tokens = (sorted_tokens.astype(jnp.float32)
+                         * flat_weight[order][:, None]).astype(dt)
+        flat_weight = jnp.ones_like(flat_weight)
     gate = jax.lax.ragged_dot(sorted_tokens, wg, group_sizes)
     up = jax.lax.ragged_dot(sorted_tokens, wu, group_sizes)
     if bg is not None:

@@ -84,6 +84,43 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 # windowed / chunked / speculation masks built on device from position ids)
 # ---------------------------------------------------------------------------
 
+def mha_hl(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+           mask: Optional[jnp.ndarray], scale: float,
+           logits_soft_cap: Optional[float] = None,
+           sink: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """:func:`mha` over the native KV-cache storage layouts
+    (modules/kv_cache.py): k TRANSPOSED (B, Hkv, D, S), v (B, Hkv, S, D).
+    Each einsum contracts its cache operand in place — with a shared
+    layout, one of the two dots costs a materialized relayout of the live
+    cache per layer per decode step (the score dot wants S on lanes, the
+    value dot wants D on lanes)."""
+    b, t, hq, d = q.shape
+    hkv = k.shape[1]
+    g = hq // hkv
+    qk = q.reshape(b, t, hkv, g, d)
+    scores = jnp.einsum("bthgd,bhds->bhgts", qk, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logits_soft_cap is not None:
+        scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    if sink is not None:
+        sink_col = jnp.broadcast_to(
+            sink.astype(jnp.float32).reshape(1, hkv, g, 1, 1),
+            (b, hkv, g, t, 1))
+        scores_all = jnp.concatenate([scores, sink_col], axis=-1)
+        m = jnp.max(scores_all, axis=-1, keepdims=True)
+        e = jnp.exp(scores_all - m)
+        probs = (e / jnp.sum(e, axis=-1, keepdims=True))[..., :-1]
+    else:
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        e = jnp.exp(scores - m)
+        probs = e / jnp.sum(e, axis=-1, keepdims=True)
+    out = jnp.einsum("bhgts,bhsd->bthgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, t, hq, v.shape[-1]).astype(q.dtype)
+
+
 def causal_mask(position_ids: jnp.ndarray, kv_positions: jnp.ndarray,
                 kv_valid: Optional[jnp.ndarray] = None,
                 window: int = 0, chunk: int = 0) -> jnp.ndarray:

@@ -116,7 +116,9 @@ def make_attn_only(n):
         def step(carry, _):
             acc, cch = carry
             def body(c2, xs):
-                kc, vc = xs
+                kc, vc = xs                       # (B, H, S, D) head-leading
+                kc = jnp.swapaxes(kc, 1, 2)
+                vc = jnp.swapaxes(vc, 1, 2)
                 q = jnp.full((batch, 1, spec.gqa.num_q_heads, spec.head_dim),
                              c2 * 1e-9 + 1.0, jnp.bfloat16)
                 o = attn_ops.mha(q, kc, vc, None, spec.scale)

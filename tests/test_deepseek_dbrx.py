@@ -63,7 +63,7 @@ def test_deepseek_v3_matches_hf(tmp_path):
     assert app.spec.first_dense == 2
     assert app.spec.moe.n_group == 2
     # MLA cache: K dim = nope+rope, V dim = v_head_dim
-    assert app.cache["k"].shape[-1] == 24
+    assert app.cache["k"].shape[3] == 24   # transposed-K: D is dim 3
     assert app.cache["v"].shape[-1] == 16
 
 

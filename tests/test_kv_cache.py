@@ -1,4 +1,7 @@
-"""KV cache manager tests (reference analog: test/unit kv cache tests)."""
+"""KV cache manager tests (reference analog: test/unit kv cache tests).
+
+Native cache layouts: K stored TRANSPOSED — stacked (L, B, H, D, S) — and
+V head-leading (L, B, H, S, D); see modules/kv_cache.py layout rationale."""
 
 import jax.numpy as jnp
 import numpy as np
@@ -16,18 +19,20 @@ def _spec(**over):
 def test_init_shape():
     spec = _spec()
     c = kv.init_cache(spec)
-    assert c["k"].shape == (2, 4, 16, 2, 8)
+    assert c["k"].shape == (2, 4, 2, 8, 16)   # (L, B, H, D, S) transposed
+    assert c["v"].shape == (2, 4, 2, 16, 8)   # (L, B, H, S, D)
+    assert kv.cache_len_of(c) == 16
     assert c["v"].dtype == jnp.float32
 
 
 def test_prefill_write_rows():
     spec = _spec()
     c = kv.init_cache(spec)
-    new = jnp.ones((2, 5, 2, 8))
-    out = kv.write_prefill(c["k"][0], new, jnp.asarray([2, 0]))
-    out = np.asarray(out)
-    assert (out[2, :5] == 1).all() and (out[0, :5] == 1).all()
-    assert (out[2, 5:] == 0).all()
+    new = jnp.ones((2, 5, 2, 8))                       # (b, t, H, D)
+    out = kv.write_prefill(c["v"][0], new, jnp.asarray([2, 0]))
+    out = np.asarray(out)                              # (B, H, S, D)
+    assert (out[2, :, :5] == 1).all() and (out[0, :, :5] == 1).all()
+    assert (out[2, :, 5:] == 0).all()
     assert (out[1] == 0).all() and (out[3] == 0).all()
 
 
@@ -35,10 +40,10 @@ def test_decode_scatter_positions():
     spec = _spec()
     c = kv.init_cache(spec)
     new = jnp.full((2, 1, 2, 8), 7.0)
-    out = kv.write_tokens(c["k"][0], new, jnp.asarray([1, 3]),
+    out = kv.write_tokens(c["v"][0], new, jnp.asarray([1, 3]),
                           jnp.asarray([[4], [9]]))
     out = np.asarray(out)
-    assert (out[1, 4] == 7).all() and (out[3, 9] == 7).all()
+    assert (out[1, :, 4] == 7).all() and (out[3, :, 9] == 7).all()
     assert out.sum() == 7 * 2 * 2 * 8
 
 
@@ -46,8 +51,43 @@ def test_decode_write_out_of_range_dropped():
     spec = _spec()
     c = kv.init_cache(spec)
     new = jnp.full((1, 1, 2, 8), 3.0)
-    out = kv.write_tokens(c["k"][0], new, jnp.asarray([0]), jnp.asarray([[99]]))
+    out = kv.write_tokens(c["v"][0], new, jnp.asarray([0]), jnp.asarray([[99]]))
     assert np.asarray(out).sum() == 0
+
+
+def test_transposed_k_token_write():
+    """K writes land as a (H, D) column at slot pos of the (D, S) plane."""
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.arange(2 * 1 * 2 * 8, dtype=jnp.float32).reshape(2, 1, 2, 8)
+    out = kv.write_tokens_at_layer(c["k"], new, 1, jnp.asarray([0, 1]),
+                                   jnp.asarray([[4], [9]]), k_transposed=True)
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[1, 0, :, :, 4], np.asarray(new)[0, 0])
+    np.testing.assert_array_equal(out[1, 1, :, :, 9], np.asarray(new)[1, 0])
+    assert out[0].sum() == 0
+    # out-of-range dropped in the transposed layout too
+    out2 = kv.write_tokens_at_layer(c["k"], new, 0, jnp.asarray([0, 1]),
+                                    jnp.asarray([[99], [4]]),
+                                    k_transposed=True)
+    assert np.asarray(out2)[0, 0].sum() == 0
+
+
+def test_transposed_k_prefill_write():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.arange(4 * 3 * 2 * 8, dtype=jnp.float32).reshape(4, 3, 2, 8)
+    out = kv.write_prefill_at_layer(c["k"], new, 0, jnp.arange(4),
+                                    identity_seq_ids=True, k_transposed=True)
+    got = np.asarray(out)[0]                   # (B, H, D, S)
+    want = np.transpose(np.asarray(new), (0, 2, 3, 1))   # (b, H, D, s)
+    np.testing.assert_array_equal(got[:, :, :, :3], want)
+    assert got[:, :, :, 3:].sum() == 0
+    # scatter path (non-identity) must agree with the fast path
+    out2 = kv.write_prefill_at_layer(c["k"], new, 0, jnp.arange(4),
+                                     identity_seq_ids=False,
+                                     k_transposed=True)
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(out))
 
 
 def test_rolling_window_write():
@@ -55,9 +95,27 @@ def test_rolling_window_write():
     assert spec.cache_len == 8
     c = kv.init_cache(spec)
     new = jnp.full((1, 1, 2, 8), 2.0)
-    out = kv.write_tokens(c["k"][0], new, jnp.asarray([0]),
+    out = kv.write_tokens(c["v"][0], new, jnp.asarray([0]),
                           jnp.asarray([[11]]), window=8)
-    assert (np.asarray(out)[0, 3] == 2).all()  # 11 % 8
+    assert (np.asarray(out)[0, :, 3] == 2).all()  # 11 % 8
+
+
+def test_read_layer_hl_native_layouts():
+    spec = _spec()
+    c = kv.init_cache(spec)
+    new = jnp.arange(4 * 3 * 2 * 8, dtype=jnp.float32).reshape(4, 3, 2, 8)
+    ks = kv.write_prefill_at_layer(c["k"], new, 1, jnp.arange(4),
+                                   identity_seq_ids=True, k_transposed=True)
+    vs = kv.write_prefill_at_layer(c["v"], new, 1, jnp.arange(4),
+                                   identity_seq_ids=True)
+    k1 = np.asarray(kv.read_layer_hl(ks, 1))   # (B, H, D, S)
+    v1 = np.asarray(kv.read_layer_hl(vs, 1))   # (B, H, S, D)
+    assert k1.shape == (4, 2, 8, 16) and v1.shape == (4, 2, 16, 8)
+    np.testing.assert_array_equal(
+        np.transpose(k1[:, :, :, :3], (0, 3, 1, 2)), np.asarray(new))
+    np.testing.assert_array_equal(
+        np.transpose(v1[:, :, :3], (0, 2, 1, 3)), np.asarray(new))
+    assert np.asarray(kv.read_layer_hl(ks, 0)).sum() == 0
 
 
 def test_fp8_quantize_cast():

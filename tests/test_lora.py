@@ -184,14 +184,16 @@ def test_lora_delta_matches_manual_peft(tmp_path, rng):
     from safetensors.torch import load_file
     sd = load_file(str(tmp_path / "ad" / "adapter_model.safetensors"))
     merged = jax.device_get(app.params)
+    q_size = app.spec.q_size
     for i in range(2):
         a = sd[f"base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight"].numpy()
         b = sd[f"base_model.model.model.layers.{i}.self_attn.q_proj.lora_B.weight"].numpy()
         delta = (b @ a).T * (4.0 / 2)      # (H, out)
-        merged["layers"]["q_proj"] = (
-            merged["layers"]["q_proj"].copy() if i == 0
-            else merged["layers"]["q_proj"])
-        merged["layers"]["q_proj"][i] += delta
+        merged["layers"]["qkv_proj"] = (
+            merged["layers"]["qkv_proj"].copy() if i == 0
+            else merged["layers"]["qkv_proj"])
+        # q occupies the leading q_size columns of the fused projection
+        merged["layers"]["qkv_proj"][i, :, :q_size] += delta
     app2 = CausalLMApplication(None, icfg, LlamaFamily, mesh=mesh)
     app2.params = jax.tree.map(jnp.asarray, merged)
     app2.init_cache()
