@@ -54,6 +54,8 @@ class DecoderFamily:
     # HF weight name feeding the pre-MLP norm ("post_norm" in the spec);
     # sandwich-norm families (gemma3) point it at pre_feedforward_layernorm
     post_norm_src = "post_attention_layernorm"
+    # HF attention output-projection module name (phi uses "dense")
+    attn_o_src = "self_attn.o_proj"
 
     # -- spec --
     @classmethod
@@ -105,7 +107,7 @@ class DecoderFamily:
             "q_proj": layer_stack(p + ".layers.{i}.self_attn.q_proj.weight", q_t),
             "k_proj": layer_stack(p + ".layers.{i}.self_attn.k_proj.weight", kv_t),
             "v_proj": layer_stack(p + ".layers.{i}.self_attn.v_proj.weight", kv_t),
-            "o_proj": layer_stack(p + ".layers.{i}.self_attn.o_proj.weight", o_t),
+            "o_proj": layer_stack(p + ".layers.{i}." + cls.attn_o_src + ".weight", o_t),
             "post_norm": layer_stack(
                 p + ".layers.{i}." + cls.post_norm_src + ".weight", ident),
         }
@@ -123,7 +125,7 @@ class DecoderFamily:
             layers["v_bias"] = layer_stack(p + ".layers.{i}.self_attn.v_proj.bias", kv_b)
         if spec.o_bias:
             layers["o_bias"] = layer_stack(
-                p + ".layers.{i}.self_attn.o_proj.bias", ident)
+                p + ".layers.{i}." + cls.attn_o_src + ".bias", ident)
         if spec.qk_norm:
             layers["q_norm"] = layer_stack(p + ".layers.{i}.self_attn.q_norm.weight", ident)
             layers["k_norm"] = layer_stack(p + ".layers.{i}.self_attn.k_norm.weight", ident)

@@ -167,6 +167,25 @@ class DecoderSpec:
     first_dense: int = 0
     # "rms" | "layernorm" (dbrx uses bias-free LayerNorm)
     norm_type: str = "rms"
+    # LayerNorm with learned bias (gpt2/falcon/starcoder2/phi/neox)
+    norm_bias: bool = False
+    # GLU MLP (act(gate)*up @ down, llama-shaped) vs plain 2-layer MLP
+    # (act(x@fc1) @ fc2 — gpt2/falcon/starcoder2/phi/neox); plain reuses
+    # the gate_proj/down_proj param slots as fc1/fc2
+    mlp_glu: bool = True
+    # skip rotary entirely (gpt2 learned positions; cos=1/sin=0)
+    no_rope: bool = False
+    # learned absolute position embeddings: adds a (max_positions, H)
+    # "pos_embed" param gathered at position_ids and added to the token
+    # embedding (gpt2 wpe)
+    learned_pos: int = 0          # 0 = none, else table size
+    # lm_head bias (phi-1/2)
+    lm_head_bias: bool = False
+    # residual block style: "sequential" (llama), "parallel_shared" (one
+    # norm feeds both attn and MLP — falcon parallel_attn / phi), or
+    # "parallel_dual" (separate norms, both from the block INPUT — gpt-neox
+    # use_parallel_residual)
+    block_style: str = "sequential"
     # clamp q/k/v projections to ±qkv_clip (dbrx clip_qkv)
     qkv_clip: Optional[float] = None
     # interleaved (GPT-NeoX pair) rope convention (deepseek rope_interleave)
@@ -236,6 +255,9 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
         "input_norm": ParamSpec((L, H), P(), dt, "ones"),
         "post_norm": ParamSpec((L, H), P(), dt, "ones"),
     }
+    if spec.norm_bias:
+        layers["input_norm_b"] = ParamSpec((L, H), P(), dt, "zeros")
+        layers["post_norm_b"] = ParamSpec((L, H), P(), dt, "zeros")
     if spec.mla is not None:
         m = spec.mla
         nh = spec.gqa.num_q_heads
@@ -317,12 +339,21 @@ def _dense_mlp_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
     dt = spec.dtype
     layers = {
         "gate_proj": column_parallel(H, I, dt, True, L),
-        "up_proj": column_parallel(H, I, dt, True, L),
         "down_proj": row_parallel(I, H, dt, True, L),
     }
+    if spec.mlp_glu:
+        layers["up_proj"] = column_parallel(H, I, dt, True, L)
+    if spec.mlp_bias:
+        layers["gate_bias"] = ParamSpec((L, I), P(None, AXIS_MP), dt, "zeros")
+        layers["down_bias"] = ParamSpec((L, H), P(), dt, "zeros")
+        if spec.mlp_glu:
+            layers["up_bias"] = ParamSpec((L, I), P(None, AXIS_MP), dt,
+                                          "zeros")
     if spec.lora is not None:
-        _add_lora_specs(spec, layers, L, {
-            "gate_proj": (H, I), "up_proj": (H, I), "down_proj": (I, H)})
+        dims = {"gate_proj": (H, I), "down_proj": (I, H)}
+        if spec.mlp_glu:
+            dims["up_proj"] = (H, I)
+        _add_lora_specs(spec, layers, L, dims)
     return layers
 
 
@@ -368,6 +399,10 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_MP, None), dt),
         "final_norm": ParamSpec((H,), P(), dt, "ones"),
     }
+    if spec.norm_bias:
+        out["final_norm_b"] = ParamSpec((H,), P(), dt, "zeros")
+    if spec.learned_pos:
+        out["pos_embed"] = ParamSpec((spec.learned_pos, H), P(), dt)
     if spec.moe is not None and spec.first_dense > 0:
         n_dense, n_moe = spec.first_dense, L - spec.first_dense
         dense = _attn_param_specs(spec, n_dense)
@@ -395,6 +430,9 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         out["layers"] = layers
     if not spec.tie_word_embeddings:
         out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_MP), dt)
+        if spec.lm_head_bias:
+            out["lm_head_b"] = ParamSpec((spec.padded_vocab,),
+                                         P(AXIS_MP), dt, "zeros")
     if spec.medusa_heads > 0:
         M = spec.medusa_heads
         out["medusa_blocks"] = ParamSpec((M, H, H), P(), dt)
@@ -471,11 +509,11 @@ def _split_heads(x: jnp.ndarray, n_heads: int, head_dim: int) -> jnp.ndarray:
     return x.reshape(b, t, n_heads, head_dim)
 
 
-def _norm(spec: DecoderSpec, x, w):
+def _norm(spec: DecoderSpec, x, w, b=None):
     """Pre/post-block norm: RMSNorm (default, with optional gemma offset) or
-    bias-free LayerNorm (dbrx)."""
+    LayerNorm (dbrx bias-free; gpt2-family with bias)."""
     if spec.norm_type == "layernorm":
-        return layer_norm(x, w, None, spec.rms_eps)
+        return layer_norm(x, w, b, spec.rms_eps)
     return rms_norm(x, w, spec.rms_eps, spec.norm_offset)
 
 
@@ -526,6 +564,8 @@ def attn_inputs(spec: DecoderSpec, position_ids, make_mask,
     per-layer branching (SURVEY §2.7)."""
     rp = rope_positions if rope_positions is not None else position_ids
     cos, sin = rope_cos_sin(rp, spec.rope)
+    if spec.no_rope:
+        cos, sin = jnp.ones_like(cos), jnp.zeros_like(sin)
     ai: Dict[str, Any] = {"cos": cos, "sin": sin}
     if spec.layer_pattern is None:
         ai["mask"] = make_mask(spec.sliding_window, spec.attn_chunk)
@@ -593,8 +633,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     else:
         cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
     sink = layer_w["sink"] if spec.attn_sink else None
-    h = (_norm(spec, hidden, layer_w["input_norm"])
+    h = (_norm(spec, hidden, layer_w["input_norm"],
+               layer_w.get("input_norm_b") if spec.norm_bias else None)
          if spec.norm_position == "pre" else hidden)
+    attn_in = h        # parallel blocks feed the MLP from the same norm
     if spec.mla is not None:
         q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
     else:
@@ -779,21 +821,57 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     # SP: residual stream stays seq-sharded between blocks during prefill
     # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
     sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
+
+    def _mlp(x_in):
+        if mlp_kind == "moe":
+            return moe_block(spec.moe, x_in, layer_w)
+        act = ACT_FNS[spec.act]
+        if not spec.mlp_glu:
+            # plain 2-layer MLP (gpt2/falcon/starcoder2/phi/neox):
+            # gate_proj/down_proj slots hold fc1/fc2
+            inter = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
+                               qlinear(x_in, layer_w["gate_proj"]),
+                               adapter_ids)
+            if spec.mlp_bias:
+                inter = inter + layer_w["gate_bias"]
+            inter = _shard(act(inter), AXIS_DP, None, AXIS_MP)
+            y = apply_lora(spec.lora, layer_w, "down_proj", inter,
+                           qlinear(inter, layer_w["down_proj"]), adapter_ids)
+            if spec.mlp_bias:
+                y = y + layer_w["down_bias"]
+            return y
+        gate = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
+                          qlinear(x_in, layer_w["gate_proj"]), adapter_ids)
+        up = apply_lora(spec.lora, layer_w, "up_proj", x_in,
+                        qlinear(x_in, layer_w["up_proj"]), adapter_ids)
+        if spec.mlp_bias:
+            gate = gate + layer_w["gate_bias"]
+            up = up + layer_w["up_bias"]
+        inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
+        y = apply_lora(spec.lora, layer_w, "down_proj", inter,
+                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
+        if spec.mlp_bias:
+            y = y + layer_w["down_bias"]
+        return y
+
+    if spec.block_style != "sequential":
+        # parallel residual: x + attn(norm(x)) + mlp(norm'(x)) (falcon
+        # parallel_attn / phi share the attention norm; gpt-neox
+        # use_parallel_residual has its own post norm over the INPUT)
+        mlp_in = attn_in if spec.block_style == "parallel_shared" else             _norm(spec, hidden, layer_w["post_norm"],
+                  layer_w.get("post_norm_b") if spec.norm_bias else None)
+        m = _tap("mlp_output", _mlp(mlp_in))
+        hidden = hidden + spec.residual_multiplier * _shard(
+            h + m, AXIS_DP, sp_axis, None)
+        hidden = _tap("layer_output", hidden)
+        return hidden, k_full, v_full, caps
+
     hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
 
-    h = (_norm(spec, hidden, layer_w["post_norm"])
+    h = (_norm(spec, hidden, layer_w["post_norm"],
+               layer_w.get("post_norm_b") if spec.norm_bias else None)
          if spec.norm_position == "pre" else hidden)
-    if mlp_kind == "moe":
-        h = moe_block(spec.moe, h, layer_w)
-    else:
-        act = ACT_FNS[spec.act]
-        gate = apply_lora(spec.lora, layer_w, "gate_proj", h,
-                          qlinear(h, layer_w["gate_proj"]), adapter_ids)
-        up = apply_lora(spec.lora, layer_w, "up_proj", h,
-                        qlinear(h, layer_w["up_proj"]), adapter_ids)
-        inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
-        h = apply_lora(spec.lora, layer_w, "down_proj", inter,
-                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
+    h = _mlp(h)
     if spec.sandwich_norm:
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
     h = _tap("mlp_output", h)
@@ -941,17 +1019,23 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
 # Step graphs
 # ---------------------------------------------------------------------------
 
-def _embed(spec: DecoderSpec, params, input_ids):
+def _embed(spec: DecoderSpec, params, input_ids, position_ids=None):
     h = params["embed"][input_ids]        # sharded-vocab gather; XLA SPMD handles
     if spec.embed_scale is not None:
         h = (h.astype(jnp.float32) * spec.embed_scale).astype(h.dtype)
+    if spec.learned_pos and position_ids is not None:
+        # gpt2 wpe: learned absolute position table added to token embeds
+        h = h + params["pos_embed"][jnp.clip(position_ids, 0,
+                                             spec.learned_pos - 1)]
     return _shard(h, AXIS_DP, None, None)
 
 
 def _lm_head(spec: DecoderSpec, params, hidden):
-    h = _norm(spec, hidden, params["final_norm"])
+    h = _norm(spec, hidden, params["final_norm"], params.get("final_norm_b"))
     w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     logits = (h @ w).astype(jnp.float32)
+    if spec.lm_head_bias and "lm_head_b" in params:
+        logits = logits + params["lm_head_b"].astype(jnp.float32)
     if spec.logits_divide:
         logits = logits / spec.logits_divide
     if spec.logits_soft_cap:
@@ -980,7 +1064,7 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         rope_positions=rope_position_ids)
     # padded positions: mask rows beyond seq_len attend only to themselves —
     # harmless, their outputs are discarded.
-    hidden = _embed(spec, params, input_ids)
+    hidden = _embed(spec, params, input_ids, position_ids)
     if image_embeds is not None:
         # scatter the i-th image feature into the i-th image-token slot
         gather_idx = jnp.clip(jnp.cumsum(image_mask, axis=1) - 1, 0,
@@ -1038,7 +1122,7 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
         position_ids, cache_len, window=w, chunk=c),
         rope_positions=rope_position_ids)
-    hidden = _embed(spec, params, input_ids)
+    hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
         identity_seq_ids=not tpu_cfg.is_continuous_batching,
@@ -1064,7 +1148,7 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     cache_len = kv.cache_len_of(cache)
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
         position_ids, cache_len, window=w, chunk=c))
-    hidden = _embed(spec, params, input_ids)
+    hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids,
         "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching)
@@ -1093,7 +1177,7 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     kv_len = block_table.shape[1] * cache["k"].shape[2]
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
         position_ids, kv_len, window=w, chunk=c))
-    hidden = _embed(spec, params, input_ids)
+    hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
         "paged", slot_mapping=slot_mapping, block_table=block_table)
@@ -1167,9 +1251,22 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     (reference analog: each model's ``setup_attr_for_model`` + init_model)."""
     tcfg = config.tpu_config
     tp = tp_degree if tp_degree is not None else tcfg.tp_degree
-    n_q = config.num_attention_heads
-    n_kv = getattr(config, "num_key_value_heads", None) or n_q
-    head_dim = getattr(config, "head_dim", None) or config.hidden_size // n_q
+    # core geometry: explicit overrides win (families whose HF configs use
+    # non-standard attribute names — gpt2 n_embd/n_head — pass them in)
+    n_q = overrides.pop("num_q_heads",
+                        getattr(config, "num_attention_heads", None))
+    n_kv = overrides.pop("num_kv_heads",
+                         getattr(config, "num_key_value_heads", None)) or n_q
+    hidden = overrides.pop("hidden_size",
+                           getattr(config, "hidden_size", None))
+    head_dim = (overrides.pop("head_dim", None)
+                or getattr(config, "head_dim", None) or hidden // n_q)
+    n_layers = overrides.pop("num_layers",
+                             getattr(config, "num_hidden_layers", None))
+    inter = overrides.pop("intermediate_size",
+                          getattr(config, "intermediate_size", None))
+    rotary_dim = overrides.pop("rotary_dim",
+                               getattr(config, "rotary_dim", None))
     gqa = resolve_gqa_sharding(n_q, n_kv, tp)
     rope_scaling = getattr(config, "rope_scaling", None) or {}
     rope_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
@@ -1184,7 +1281,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     rope = RopeConfig(
         head_dim=head_dim,
         rope_theta=float(getattr(config, "rope_theta", 10000.0)),
-        rotary_dim=getattr(config, "rotary_dim", None),
+        rotary_dim=rotary_dim,
         scaling_type=rope_type,
         scaling_factor=float(rope_scaling.get("factor", 1.0)),
         low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
@@ -1203,12 +1300,12 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     )
     vocab = config.vocab_size
     kw = dict(
-        num_layers=config.num_hidden_layers,
-        hidden_size=config.hidden_size,
+        num_layers=n_layers,
+        hidden_size=hidden,
         num_q_heads=n_q,
         num_kv_heads=n_kv,
         head_dim=head_dim,
-        intermediate_size=config.intermediate_size,
+        intermediate_size=inter,
         vocab_size=vocab,
         padded_vocab=pad_vocab(vocab, tp),
         rms_eps=float(getattr(config, "rms_norm_eps", 1e-6)),
@@ -1237,4 +1334,10 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)
+    if kw.get("learned_pos") and tcfg.seq_len > kw["learned_pos"]:
+        # decoding past the learned position table would silently reuse the
+        # last embedding (HF raises an index error) — fail loudly instead
+        raise ValueError(
+            f"seq_len {tcfg.seq_len} exceeds the learned position table "
+            f"({kw['learned_pos']} positions)")
     return DecoderSpec(**kw)
