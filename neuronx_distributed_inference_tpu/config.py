@@ -222,7 +222,9 @@ class TpuConfig:
     ep_degree: int = 1
     mlp_cp_degree: int = 1
     sequence_parallel_enabled: bool = False
-    vocab_parallel: bool = False
+    # vocab-parallel embedding table (sharded on V); False replicates the
+    # table on every device (reference: models/config.py:142)
+    vocab_parallel: bool = True
     world_size: Optional[int] = None
     start_rank_id: int = 0
     local_ranks_size: Optional[int] = None
@@ -346,6 +348,22 @@ class TpuConfig:
                 raise ValueError("attention_dp_degree must divide tp_degree")
             if self.tkg_batch_size % self.attention_dp_degree != 0:
                 raise ValueError("tkg_batch_size must be divisible by attention_dp_degree")
+        if self.pp_degree > 1:
+            # honest surface: like the reference, there is no pipeline
+            # SCHEDULE in the inference path (reference plumbs pp into
+            # ModelBuilder but runs no pipeline, SURVEY §2.8); refuse
+            # rather than silently running tp-only
+            raise ValueError(
+                "pp_degree > 1 is not supported: inference has no pipeline "
+                "schedule (shard wider with tp_degree instead)")
+        if self.mlp_cp_degree > 1:
+            if not self.sequence_parallel_enabled or \
+                    self.mlp_cp_degree != max(self.cp_degree, 1):
+                raise ValueError(
+                    "mlp_cp_degree requires sequence_parallel_enabled and "
+                    "mlp_cp_degree == cp_degree: MLP context parallelism is "
+                    "realized as sequence-sharded MLP activations over the "
+                    "cp axis (model_base._layer_body sp_axis)")
         if self.is_chunked_prefill and not self.is_block_kv_layout:
             raise ValueError("chunked prefill requires block KV layout")
         if self.is_prefix_caching and not self.is_block_kv_layout:

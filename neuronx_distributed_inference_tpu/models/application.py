@@ -146,6 +146,31 @@ class CausalLMApplication:
         self.params = ckpt.device_put_params(host, shardings, dtype=None)
         return self
 
+    def save_converted_checkpoint(self, path: str):
+        """Save the post-conversion param tree (fused qkv, padded heads,
+        stacked layers) so reload skips HF conversion — the analog of the
+        reference's pre-sharded per-rank checkpoints
+        (application_base.py:389-399 save_sharded_checkpoint); triggered by
+        ``save_sharded_checkpoint`` at compile()."""
+        if self.params is None:
+            raise RuntimeError("load_weights() first")
+        host = jax.device_get(self.params)
+        flat = _flatten_tree(host)
+        ckpt.save_state_dict_safetensors(
+            {k: np.asarray(v) for k, v in flat.items()},
+            os.path.join(path, "converted"))
+        self.config.save(path + os.sep)
+
+    def load_converted_checkpoint(self, path: str):
+        """Load a :meth:`save_converted_checkpoint` artifact (no HF
+        conversion pass)."""
+        sd = ckpt.load_state_dict(os.path.join(path, "converted"))
+        host = _unflatten_tree(sd)
+        shardings = model_base.param_shardings(self.spec, self.mesh)
+        self.params = ckpt.device_put_params(host, shardings,
+                                             dtype=self.spec.dtype)
+        return self
+
     def init_cache(self):
         cfg = self.tpu_config
         spec = KVCacheSpec(
@@ -219,6 +244,9 @@ class CausalLMApplication:
             if not self.tpu_config.compile_cache_dir:
                 jax.config.update("jax_compilation_cache_dir", compiled_model_path)
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+            if self.tpu_config.save_sharded_checkpoint and \
+                    self.params is not None:
+                self.save_converted_checkpoint(compiled_model_path)
         self.warmup()
         return self
 
@@ -276,7 +304,7 @@ class CausalLMApplication:
                      seq_ids: Optional[np.ndarray] = None,
                      sampling_params=None, adapter_ids=None,
                      image_embeds=None, image_mask=None,
-                     rope_position_ids=None):
+                     rope_position_ids=None, deepstack_embeds=None):
         b, s = input_ids.shape
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
@@ -307,7 +335,7 @@ class CausalLMApplication:
                      jnp.asarray(position_ids), jnp.asarray(seq_ids),
                      jnp.asarray(seq_lens), sampling_params, self._next_rng(),
                      adapter_ids, self.replacements, image_embeds, image_mask,
-                     rope_position_ids)
+                     rope_position_ids, deepstack_embeds)
         self.cache = out["cache"]
         return out
 
@@ -380,6 +408,7 @@ class CausalLMApplication:
                  adapter_ids: Optional[np.ndarray] = None,
                  image_embeds=None,
                  image_mask: Optional[np.ndarray] = None,
+                 deepstack_embeds=None,
                  rope_position_ids: Optional[np.ndarray] = None,
                  decode_rope_start: Optional[np.ndarray] = None
                  ) -> Dict[str, Any]:
@@ -437,6 +466,7 @@ class CausalLMApplication:
         out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params,
                                 adapter_ids=adapter_ids,
                                 image_embeds=image_embeds,
+                                deepstack_embeds=deepstack_embeds,
                                 image_mask=padded_img_mask,
                                 rope_position_ids=padded_rope)
         first = out["tokens"]                     # device array (B,)

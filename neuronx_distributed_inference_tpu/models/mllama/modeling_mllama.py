@@ -321,7 +321,60 @@ class MllamaApplication:
         host["cross_layers"] = convert_cross_layers(text_sd, self.spec,
                                                     cross_ids)
         self.params = jax.tree.map(jnp.asarray, host)
+        # vision tower + projector, when the checkpoint ships them
+        vis_prefix = next((p for p in ("model.vision_model", "vision_model")
+                           if any(k.startswith(p + ".") for k in sd)), None)
+        if vis_prefix is not None and hasattr(self.config, "vision_config"):
+            self.vis_spec = mllama_vision_spec(dict(self.config.vision_config))
+            self.vision_params = jax.tree.map(
+                jnp.asarray,
+                convert_mllama_vision(sd, self.vis_spec, vis_prefix))
+            proj = next(p for p in ("model.multi_modal_projector",
+                                    "multi_modal_projector")
+                        if f"{p}.weight" in sd)
+            self.projector_w = jnp.asarray(
+                np.ascontiguousarray(np.asarray(sd[f"{proj}.weight"],
+                                                np.float32).T))
+            self.projector_b = jnp.asarray(
+                np.asarray(sd[f"{proj}.bias"], np.float32))
+            self._vis_fn = jax.jit(partial(mllama_vision_forward,
+                                           self.vis_spec))
         return self
+
+    def encode_images(self, pixel_values: np.ndarray,
+                      aspect_ratio_ids: np.ndarray,
+                      aspect_ratio_mask: np.ndarray) -> jnp.ndarray:
+        """HF-processor-layout pixels -> projected cross-attention states
+        (B, M*T*(P+1), H_text) (reference: vision builder of the mllama
+        wrapper + multi_modal_projector)."""
+        feats = self._vis_fn(self.vision_params,
+                             jnp.asarray(pixel_values, jnp.float32),
+                             jnp.asarray(aspect_ratio_ids),
+                             jnp.asarray(aspect_ratio_mask))
+        b, m, t, p1, _ = feats.shape
+        proj = feats @ self.projector_w + self.projector_b
+        return proj.reshape(b, m * t * p1, -1)
+
+    def generate_from_images(self, input_ids: np.ndarray,
+                             pixel_values: np.ndarray,
+                             aspect_ratio_ids: np.ndarray,
+                             aspect_ratio_mask: np.ndarray,
+                             cross_attention_mask: Optional[np.ndarray] = None,
+                             **kw) -> Dict[str, Any]:
+        """End-to-end image->text: cross_attention_mask arrives in the HF
+        processor layout (B, S_text, M, T) and is expanded per patch
+        (reference: _prepare_cross_attention_mask)."""
+        states = self.encode_images(pixel_values, aspect_ratio_ids,
+                                    aspect_ratio_mask)
+        if cross_attention_mask is not None:
+            cm = np.asarray(cross_attention_mask)
+            b, s, m, t = cm.shape
+            p1 = (self.vis_spec["image_size"] //
+                  self.vis_spec["patch_size"]) ** 2 + 1
+            cross_attention_mask = np.repeat(
+                cm.reshape(b, s, m * t), p1, axis=2).astype(bool)
+        return self.generate(input_ids, np.asarray(states),
+                             cross_attention_mask=cross_attention_mask, **kw)
 
     def init_cache(self):
         cfg = self.tpu_config
@@ -407,3 +460,256 @@ class MllamaApplication:
 
 def params_cross(params):
     return params["cross_layers"]
+
+
+# ---------------------------------------------------------------------------
+# Vision tower (reference: models/mllama/modeling_mllama_vision.py +
+# encoder_utils.py — tiled ViT with gated positional embeddings, local +
+# gated-global encoders, intermediate-layer feature concat) and the
+# aspect-ratio / image-transform host pipeline (reference:
+# models/mllama/image_transform.py, aspect_ratio_utils.py).
+# ---------------------------------------------------------------------------
+
+from ...ops.normalization import layer_norm as _ln
+
+
+def mllama_vision_spec(vc: Dict[str, Any]) -> Dict[str, Any]:
+    return {
+        "image_size": int(vc["image_size"]),
+        "patch_size": int(vc["patch_size"]),
+        "hidden": int(vc["hidden_size"]),
+        "heads": int(vc["attention_heads"]),
+        "layers": int(vc["num_hidden_layers"]),
+        "global_layers": int(vc["num_global_layers"]),
+        "max_tiles": int(vc["max_num_tiles"]),
+        "norm_eps": float(vc.get("norm_eps", 1e-5)),
+        "intermediate_indices": tuple(
+            int(i) for i in vc["intermediate_layers_indices"]),
+        "act": vc.get("hidden_act", "gelu"),
+    }
+
+
+def _vision_mha(h, lw, nh, mask_add):
+    b, n, dim = h.shape
+    hd = dim // nh
+    q = (h @ lw["q"]).reshape(b, n, nh, hd)
+    k = (h @ lw["k"]).reshape(b, n, nh, hd)
+    v = (h @ lw["v"]).reshape(b, n, nh, hd)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (hd ** -0.5)
+    if mask_add is not None:
+        s = s + mask_add
+    p = jax.nn.softmax(s, axis=-1)
+    a = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return (a.reshape(b, n, dim).astype(h.dtype)) @ lw["o"]
+
+
+def _vision_layer(vs, h, lw, mask_add, gated):
+    eps = vs["norm_eps"]
+    # HF ACT2FN["gelu"] is the exact erf GELU
+    act = (partial(jax.nn.gelu, approximate=False) if vs["act"] == "gelu"
+           else partial(jax.nn.gelu, approximate=True))
+    r = _ln(h, lw["ln1_w"], lw["ln1_b"], eps)
+    a = _vision_mha(r, lw, vs["heads"], mask_add)
+    if gated:
+        a = jnp.tanh(lw["gate_attn"]) * a
+    h = h + a
+    r = _ln(h, lw["ln2_w"], lw["ln2_b"], eps)
+    m = act((r @ lw["fc1"] + lw["fc1_b"]).astype(jnp.float32)).astype(h.dtype)
+    m = m @ lw["fc2"] + lw["fc2_b"]
+    if gated:
+        m = jnp.tanh(lw["gate_ffn"]) * m
+    return h + m
+
+
+def mllama_vision_forward(vs: Dict[str, Any], params: Dict[str, Any],
+                          pixel_values: jnp.ndarray,
+                          aspect_ratio_ids: jnp.ndarray,
+                          aspect_ratio_mask: jnp.ndarray) -> jnp.ndarray:
+    """HF MllamaVisionModel.forward parity. pixel_values
+    (B, M, T, C, H, W); aspect_ratio_ids (B, M); aspect_ratio_mask
+    (B, M, T). Returns (B, M, T, P+1, hidden*(1+len(intermediate)))."""
+    b, m, t, c, hh, ww = pixel_values.shape
+    p = vs["patch_size"]
+    dim = vs["hidden"]
+    grid = hh // p
+    npatch = grid * grid
+    x = pixel_values.reshape(b * m * t, c, grid, p, grid, p)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(b * m * t, npatch, -1)
+    x = x @ params["patch_proj"]                      # (BMT, P, dim)
+
+    ar = aspect_ratio_ids.reshape(b * m)
+    # pre-tile positional embedding (gated)
+    pre = params["pre_tile_embed"][ar].reshape(b * m, vs["max_tiles"], 1, dim)
+    x = x.reshape(b * m, t, npatch, dim) + jnp.tanh(params["pre_tile_gate"]) \
+        * pre[:, :t]
+    # cls token FIRST (HF cat([class, patches]))
+    cls = jnp.broadcast_to(params["class_embedding"][None, None, None, :],
+                           (b * m, t, 1, dim))
+    x = jnp.concatenate([cls, x.reshape(b * m, t, npatch, dim)], axis=2)
+    np1 = npatch + 1
+    # gated positional embedding: (1-tanh(g))*pos + tanh(g)*tile_pos[ar]
+    g = jnp.tanh(params["pos_gate"])
+    x = x + (1.0 - g) * params["pos_embed"][None, None]
+    tile_pos = params["tile_pos_embed"][ar].reshape(
+        b * m, vs["max_tiles"], np1, dim)
+    x = x + g * tile_pos[:, :t]
+    x = _ln(x, params["ln_pre_w"], params["ln_pre_b"], 1e-5)
+
+    # pad patches to a multiple of 8 (HF does; the zero-content pad rows ARE
+    # attendable under HF's mask semantics, so parity requires the pad)
+    pad = (8 - np1 % 8) % 8
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    L = np1 + pad
+    # additive mask (HF _prepare_aspect_ratio_attention_mask): mark pad
+    # TILES and pad PATCH rows, mask only pairs where both sides are pad
+    mask = jnp.broadcast_to(
+        aspect_ratio_mask.reshape(b * m, t, 1).astype(jnp.float32),
+        (b * m, t, L))
+    if pad:
+        mask = mask.at[:, :, -pad:].set(0.0)
+    inv = (1.0 - mask).reshape(b * m, t * L, 1)
+    mask_add = (inv @ jnp.swapaxes(inv, 1, 2)) * jnp.finfo(jnp.float32).min
+    mask_add = mask_add[:, None]                      # (BM, 1, TL, TL)
+
+    h = x.reshape(b * m, t * L, dim)
+    inter = []
+    for i in range(vs["layers"]):
+        if i in vs["intermediate_indices"]:
+            inter.append(h)
+        lw = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+        h = _vision_layer(vs, h, lw, mask_add, gated=False)
+    if vs["layers"] in vs["intermediate_indices"]:
+        inter.append(h)
+    h = _ln(h, params["ln_post_w"], params["ln_post_b"], 1e-5)
+
+    # global encoder with post-tile embedding
+    post = params["post_tile_embed"][ar].reshape(
+        b * m, vs["max_tiles"], 1, dim)
+    h = h.reshape(b * m, t, L, dim) + jnp.tanh(params["post_tile_gate"]) \
+        * post[:, :t]
+    h = h.reshape(b * m, t * L, dim)
+    for i in range(vs["global_layers"]):
+        lw = jax.tree.map(lambda a, i=i: a[i], params["global_layers"])
+        h = _vision_layer(vs, h, lw, mask_add, gated=True)
+
+    h = h.reshape(b * m, t, L, dim)[:, :, :np1]
+    inter = jnp.stack([y.reshape(b * m, t, L, dim)[:, :, :np1]
+                       for y in inter], axis=-1)
+    inter = inter.reshape(b * m, t, np1, -1)
+    out = jnp.concatenate([h, inter], axis=-1)
+    return out.reshape(b, m, t, np1, -1)
+
+
+def convert_mllama_vision(sd: Dict[str, np.ndarray], vs: Dict[str, Any],
+                          prefix: str = "vision_model") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def enc_layers(base, n, gated):
+        def lw(i):
+            b = f"{base}.layers.{i}"
+            d = {
+                "ln1_w": get(f"{b}.input_layernorm.weight"),
+                "ln1_b": get(f"{b}.input_layernorm.bias"),
+                "ln2_w": get(f"{b}.post_attention_layernorm.weight"),
+                "ln2_b": get(f"{b}.post_attention_layernorm.bias"),
+                "q": t(get(f"{b}.self_attn.q_proj.weight")),
+                "k": t(get(f"{b}.self_attn.k_proj.weight")),
+                "v": t(get(f"{b}.self_attn.v_proj.weight")),
+                "o": t(get(f"{b}.self_attn.o_proj.weight")),
+                "fc1": t(get(f"{b}.mlp.fc1.weight")),
+                "fc1_b": get(f"{b}.mlp.fc1.bias"),
+                "fc2": t(get(f"{b}.mlp.fc2.weight")),
+                "fc2_b": get(f"{b}.mlp.fc2.bias"),
+            }
+            if gated:
+                d["gate_attn"] = get(f"{b}.gate_attn").reshape(())
+                d["gate_ffn"] = get(f"{b}.gate_ffn").reshape(())
+            return d
+
+        ls = [lw(i) for i in range(n)]
+        return {k: np.stack([d[k] for d in ls]) for k in ls[0]}
+
+    return {
+        "patch_proj": t(get("patch_embedding.weight").reshape(
+            vs["hidden"], -1)),
+        "class_embedding": get("class_embedding"),
+        "pos_embed": get("gated_positional_embedding.embedding"),
+        "pos_gate": get("gated_positional_embedding.gate").reshape(()),
+        "tile_pos_embed": get("gated_positional_embedding.tile_embedding.weight"),
+        "pre_tile_embed": get("pre_tile_positional_embedding.embedding.weight"),
+        "pre_tile_gate": get("pre_tile_positional_embedding.gate").reshape(()),
+        "post_tile_embed": get("post_tile_positional_embedding.embedding.weight"),
+        "post_tile_gate": get("post_tile_positional_embedding.gate").reshape(()),
+        "ln_pre_w": get("layernorm_pre.weight"),
+        "ln_pre_b": get("layernorm_pre.bias"),
+        "ln_post_w": get("layernorm_post.weight"),
+        "ln_post_b": get("layernorm_post.bias"),
+        "layers": enc_layers("transformer", vs["layers"], False),
+        "global_layers": enc_layers("global_transformer",
+                                    vs["global_layers"], True),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host-side aspect-ratio / image-transform pipeline (reference:
+# models/mllama/aspect_ratio_utils.py + image_transform.py): choose a tile
+# arrangement for an arbitrary image, resize + pad onto the tile canvas,
+# split into tiles, and produce aspect_ratio_ids/mask for the tower.
+# ---------------------------------------------------------------------------
+
+def supported_aspect_ratios(max_num_tiles: int):
+    """All (w, h) tile arrangements with w*h <= max_num_tiles, in HF
+    processor order (width-major)."""
+    out = []
+    for w in range(1, max_num_tiles + 1):
+        for h in range(1, max_num_tiles + 1):
+            if w * h <= max_num_tiles:
+                out.append((w, h))
+    return out
+
+
+def choose_canvas(img_h: int, img_w: int, tile_size: int,
+                  max_num_tiles: int):
+    """Pick the (w_tiles, h_tiles) canvas: smallest upscale that fits, else
+    the largest-area downscale (HF get_optimal_tiled_canvas semantics)."""
+    best_up = None
+    best_down = None
+    for (tw, th) in supported_aspect_ratios(max_num_tiles):
+        cw, ch = tw * tile_size, th * tile_size
+        scale = min(cw / img_w, ch / img_h)
+        if scale >= 1:
+            key = (scale, cw * ch)
+            if best_up is None or key < best_up[0]:
+                best_up = (key, (tw, th))
+        else:
+            # largest scale first, then SMALLEST canvas area (HF
+            # get_optimal_tiled_canvas tie-break)
+            key = (-scale, cw * ch)
+            if best_down is None or key < best_down[0]:
+                best_down = (key, (tw, th))
+    return (best_up or best_down)[1]
+
+
+def image_to_tiles(img: np.ndarray, tile_size: int, max_num_tiles: int):
+    """img (C, H, W) float -> (tiles (T, C, tile, tile), aspect_ratio_id,
+    num_tiles). Bilinear resize preserving aspect, zero-pad, split."""
+    c, h, w = img.shape
+    tw, th = choose_canvas(h, w, tile_size, max_num_tiles)
+    cw, ch = tw * tile_size, th * tile_size
+    scale = min(cw / w, ch / h)
+    nh, nw = max(1, int(round(h * scale))), max(1, int(round(w * scale)))
+    # bilinear resize via jax.image (host-side, tiny)
+    resized = np.asarray(jax.image.resize(jnp.asarray(img, jnp.float32),
+                                          (c, nh, nw), "bilinear"))
+    canvas = np.zeros((c, ch, cw), np.float32)
+    canvas[:, :nh, :nw] = resized
+    tiles = canvas.reshape(c, th, tile_size, tw, tile_size)
+    tiles = np.transpose(tiles, (1, 3, 0, 2, 4)).reshape(
+        th * tw, c, tile_size, tile_size)
+    ar_id = supported_aspect_ratios(max_num_tiles).index((tw, th)) + 1
+    return tiles, ar_id, th * tw

@@ -39,7 +39,7 @@ from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
 from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
                                expert_column_parallel, expert_row_parallel,
                                replicated_param, resolve_gqa_sharding,
-                               row_parallel)
+                               row_parallel, vocab_parallel_embedding)
 from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
@@ -47,6 +47,9 @@ from ..modules.moe import MoESpec, moe_block
 from ..modules.lora import (LoraSpec, apply_lora, lora_spec_from_config)
 from ..modules.quantization import (QuantSpec, qlinear,
                                     quant_spec_from_config)
+
+import logging
+logger = logging.getLogger("nxdi_tpu")
 
 ACT_FNS = {
     "silu": jax.nn.silu,
@@ -181,6 +184,10 @@ class DecoderSpec:
     learned_pos: int = 0          # 0 = none, else table size
     # lm_head bias (phi-1/2)
     lm_head_bias: bool = False
+    # vocab-parallel embedding: shard the (V, H) table on V over the
+    # model-parallel axes (reference: ParallelEmbedding vocab_parallel,
+    # models/config.py:142); False = replicated table
+    vocab_parallel: bool = True
     # residual block style: "sequential" (llama), "parallel_shared" (one
     # norm feeds both attn and MLP — falcon parallel_attn / phi), or
     # "parallel_dual" (separate norms, both from the block INPUT — gpt-neox
@@ -396,7 +403,9 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
     L, H = spec.num_layers, spec.hidden_size
     dt = spec.dtype
     out: Dict[str, Any] = {
-        "embed": ParamSpec((spec.padded_vocab, H), P(AXIS_MP, None), dt),
+        "embed": (vocab_parallel_embedding(spec.padded_vocab, H, dt)
+                  if spec.vocab_parallel
+                  else ParamSpec((spec.padded_vocab, H), P(), dt)),
         "final_norm": ParamSpec((H,), P(), dt, "ones"),
     }
     if spec.norm_bias:
@@ -586,7 +595,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 arange_positions: bool = False,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
-                adapter_ids=None, replace=None, kv_view: int = None):
+                adapter_ids=None, replace=None, kv_view: int = None,
+                deepstack=None, deepstack_mask=None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -863,6 +873,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         m = _tap("mlp_output", _mlp(mlp_in))
         hidden = hidden + spec.residual_multiplier * _shard(
             h + m, AXIS_DP, sp_axis, None)
+        hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
         hidden = _tap("layer_output", hidden)
         return hidden, k_full, v_full, caps
 
@@ -876,8 +887,22 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
     h = _tap("mlp_output", h)
     hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
+    hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
     hidden = _tap("layer_output", hidden)
     return hidden, k_full, v_full, caps
+
+
+def _deepstack_add(hidden, deepstack, deepstack_mask):
+    """Add this layer's deepstack visual features at the image-token
+    positions (reference: qwen3-vl deepstack, models/model_base.py:1374-1387;
+    layers past the deepstack depth carry zeros)."""
+    if deepstack is None or deepstack_mask is None:
+        return hidden
+    gi = jnp.clip(jnp.cumsum(deepstack_mask, axis=1) - 1, 0,
+                  deepstack.shape[1] - 1)
+    img = jnp.take_along_axis(deepstack.astype(hidden.dtype),
+                              gi[..., None], axis=1)
+    return hidden + jnp.where(deepstack_mask[..., None], img, 0)
 
 
 def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
@@ -885,7 +910,8 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                identity_seq_ids: bool = False,
                arange_positions: bool = False,
                slot_mapping=None, block_table=None,
-               adapter_ids=None, replacements=None, kv_view: int = None):
+               adapter_ids=None, replacements=None, kv_view: int = None,
+               deepstack=None, deepstack_mask=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -906,20 +932,22 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
               identity_seq_ids=identity_seq_ids,
               arange_positions=arange_positions, slot_mapping=slot_mapping,
               block_table=block_table, adapter_ids=adapter_ids,
-              replacements=replacements, kv_view=kv_view)
+              replacements=replacements, kv_view=kv_view,
+              deepstack_mask=deepstack_mask)
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans carrying one contiguous cache
         nd = spec.first_dense
         L = spec.num_layers
+        ds = deepstack
         hidden, kf, vf, c1 = run_layer_slice(
             spec, params["layers"], cache["k"], cache["v"], hidden, ai,
             cache_offset=0, is_local=is_local[:nd], rep=sl(0, nd),
-            mlp_kind="dense", **kw)
+            mlp_kind="dense", deepstack=None if ds is None else ds[:nd], **kw)
         hidden, kf, vf, c2 = run_layer_slice(
             spec, params["moe_layers"], kf, vf, hidden, ai,
             cache_offset=nd, is_local=is_local[nd:], rep=sl(nd, L),
-            mlp_kind="moe", **kw)
+            mlp_kind="moe", deepstack=None if ds is None else ds[nd:], **kw)
         caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
         return hidden, {"k": kf, "v": vf}, caps
 
@@ -947,7 +975,9 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
             hidden, kf, vf, c = run_layer_slice(
                 spec, seg, kf, vf, hidden, ai, cache_offset=start,
                 is_local=is_local[start:start + count],
-                rep=sl(start, start + count), mlp_kind=kind, **kw)
+                rep=sl(start, start + count), mlp_kind=kind,
+                deepstack=(None if deepstack is None
+                           else deepstack[start:start + count]), **kw)
             caps_parts.append(c)
         caps = ({k: jnp.concatenate([c[k] for c in caps_parts])
                  for k in caps_parts[0]} if caps_parts and caps_parts[0]
@@ -957,7 +987,8 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
     L = spec.num_layers
     hidden, kf, vf, caps = run_layer_slice(
         spec, params["layers"], cache["k"], cache["v"], hidden, ai,
-        cache_offset=0, is_local=is_local, rep=rep, mlp_kind=None, **kw)
+        cache_offset=0, is_local=is_local, rep=rep, mlp_kind=None,
+        deepstack=deepstack, **kw)
     return hidden, {"k": kf, "v": vf}, caps
 
 
@@ -966,7 +997,8 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                     seq_ids, positions, phase,
                     identity_seq_ids=False, arange_positions=False,
                     slot_mapping=None, block_table=None, adapter_ids=None,
-                    replacements=None, kv_view=None):
+                    replacements=None, kv_view=None, deepstack=None,
+                    deepstack_mask=None):
     """Run one contiguous run of stacked layers against the full cache
     (cache layer index = scan index + ``cache_offset``). Exposed so families
     with interleaved non-standard layers (mllama cross-attention decoder)
@@ -1001,17 +1033,23 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
 
     def body(carry, xs):
         h, k_, v_ = carry
-        layer_w, loc, rp, li = xs
+        if deepstack is not None:
+            layer_w, loc, rp, li, ds = xs
+        else:
+            layer_w, loc, rp, li = xs
+            ds = None
         h, k_, v_, caps = _layer_body(
             spec, h, layer_w, k_, v_, li + cache_offset, ai, loc, seq_ids,
             positions, phase, identity_seq_ids, arange_positions,
             slot_mapping, block_table, mlp_kind, adapter_ids,
-            rp if replacements is not None else None, kv_view=kv_view)
+            rp if replacements is not None else None, kv_view=kv_view,
+            deepstack=ds, deepstack_mask=deepstack_mask)
         return (h, k_, v_), caps
 
-    (hidden, kf, vf), caps = jax.lax.scan(
-        body, (hidden, kf, vf),
-        (layer_params, is_local, rep, jnp.arange(n, dtype=jnp.int32)))
+    xs = (layer_params, is_local, rep, jnp.arange(n, dtype=jnp.int32))
+    if deepstack is not None:
+        xs = xs + (deepstack,)
+    (hidden, kf, vf), caps = jax.lax.scan(body, (hidden, kf, vf), xs)
     return hidden, kf, vf, caps
 
 
@@ -1048,7 +1086,8 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                           input_ids, position_ids, seq_ids, seq_lens,
                           sampling_params, rng, adapter_ids=None,
                           replacements=None, image_embeds=None,
-                          image_mask=None, rope_position_ids=None):
+                          image_mask=None, rope_position_ids=None,
+                          deepstack_embeds=None):
     """Prefill graph (reference submodel tag ``context_encoding_model``).
 
     input_ids (B, S_bucket) right-padded; seq_lens (B,) true lengths.
@@ -1078,11 +1117,21 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         hidden = _shard(hidden, AXIS_DP, AXIS_CP, None)
     # context_encoding_step always feeds arange positions per row (the host
     # shim builds them); chunked/offset prefill variants must pass False
+    if deepstack_embeds is not None:
+        # deepstack (qwen3-vl): per-layer visual features injected into the
+        # first K layers' hidden states at the image-token positions
+        # (reference: models/model_base.py:1374-1387 deepstack embeds)
+        K = deepstack_embeds.shape[0]
+        pad_l = spec.num_layers - K
+        deepstack_embeds = jnp.pad(
+            deepstack_embeds.astype(hidden.dtype),
+            ((0, pad_l), (0, 0), (0, 0), (0, 0)))
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "prefill",
         identity_seq_ids=not tpu_cfg.is_continuous_batching,
         arange_positions=True, adapter_ids=adapter_ids,
-        replacements=replacements)
+        replacements=replacements, deepstack=deepstack_embeds,
+        deepstack_mask=image_mask)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -1097,7 +1146,7 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         out["hidden_states"] = hidden
     if caps:
         out["captured"] = caps
-    out["tokens"] = sampling_ops.sample(
+    out["tokens"] = sampling_ops.sample_dp(
         logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
 
@@ -1133,7 +1182,7 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         out["captured"] = caps
     if tpu_cfg.output_logits:
         out["logits"] = logits[..., :spec.vocab_size]
-    out["tokens"] = sampling_ops.sample(
+    out["tokens"] = sampling_ops.sample_dp(
         logits[:, -1, :], tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
 
@@ -1187,7 +1236,7 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     out = {"cache": new_cache}
     if tpu_cfg.output_logits:
         out["logits"] = _lm_head(spec, params, hidden)[..., :spec.vocab_size]
-    out["tokens"] = sampling_ops.sample(
+    out["tokens"] = sampling_ops.sample_dp(
         logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
 
@@ -1273,9 +1322,12 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     # "default"/"mrope" are not frequency-scaling schemes: default = plain
     # rope; mrope = 3-axis multimodal sections (qwen2-VL)
     mrope_section = None
+    mrope_interleaved = False
     if rope_type in ("default", "mrope"):
         if "mrope_section" in rope_scaling:
             mrope_section = tuple(int(x) for x in rope_scaling["mrope_section"])
+            mrope_interleaved = bool(rope_scaling.get("mrope_interleaved",
+                                                      False))
         rope_type = None
     attention_factor = rope_scaling.get("attention_factor")
     rope = RopeConfig(
@@ -1297,6 +1349,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
                           if attention_factor is not None else None),
         truncate=bool(rope_scaling.get("truncate", True)),
         mrope_section=mrope_section,
+        mrope_interleaved=mrope_interleaved,
     )
     vocab = config.vocab_size
     kw = dict(
@@ -1324,6 +1377,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         flash_prefill=bool(tcfg.attn_kernel_enabled),
         # tri-state passthrough (None = auto cost-model admission)
         decode_kernel=tcfg.attn_block_tkg_kernel_enabled,
+        vocab_parallel=tcfg.vocab_parallel,
         quant=quant_spec_from_config(tcfg),
         lora=lora_spec_from_config(tcfg),
         seq_parallel=bool(tcfg.sequence_parallel_enabled),
@@ -1334,6 +1388,13 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)
+    if not kw.get("vocab_parallel", True) and tp > 1:
+        # older saved configs carry vocab_parallel=false from when the knob
+        # was inert; honoring it replicates the (V, H) table on every device
+        logger.warning(
+            "vocab_parallel=False with tp=%d: the embedding table will be "
+            "REPLICATED on every device (%.0f MB each at bf16)", tp,
+            kw["padded_vocab"] * kw["hidden_size"] * 2 / 1e6)
     if kw.get("learned_pos") and tcfg.seq_len > kw["learned_pos"]:
         # decoding past the learned position table would silently reuse the
         # last embedding (HF raises an index error) — fail loudly instead

@@ -1,0 +1,2 @@
+from .modeling_qwen3_vl import (Qwen3VLApplication,  # noqa: F401
+                                Qwen3VLInferenceConfig)

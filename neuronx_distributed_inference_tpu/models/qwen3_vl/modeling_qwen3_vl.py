@@ -1,0 +1,323 @@
+"""Qwen3-VL family (reference: models/qwen3_vl/ — 2318 LoC; SURVEY §2.7):
+qwen3 text (per-head q/k RMSNorm) with INTERLEAVED M-RoPE, a ViT vision
+tower with bilinearly-interpolated learned position embeddings, and
+DEEPSTACK — visual features tapped at several vision depths, merged with
+post-shuffle norms, and injected into the first K text layers' hidden
+states at the image-token positions (reference: models/model_base.py:
+1374-1387 deepstack embeds; vision side modeling_qwen3_vl.py).
+
+The text stack runs entirely on the shared DecoderSpec machinery
+(model_base.py deepstack/deepstack_mask threading); the vision tower is a
+functional ViT in the qwen2_vl style."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...config import InferenceConfig
+from ...ops.normalization import layer_norm
+from ..family import register_family
+from ..qwen3.modeling_qwen3 import Qwen3Family, Qwen3InferenceConfig
+from ..qwen2_vl.modeling_qwen2_vl import get_rope_index, vision_rot_angles
+
+
+@dataclass(frozen=True)
+class Qwen3VLVisionSpec:
+    depth: int
+    embed_dim: int
+    num_heads: int
+    mlp_hidden: int
+    patch_input: int
+    spatial_merge: int
+    out_hidden: int
+    num_pos: int                       # learned pos-embed table size
+    deepstack_indexes: Tuple[int, ...]
+    act: str = "gelu_pytorch_tanh"
+    eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def grid_side(self) -> int:
+        return int(self.num_pos ** 0.5)
+
+
+def qwen3vl_vision_spec(vc: Dict[str, Any]) -> Qwen3VLVisionSpec:
+    embed = int(vc["hidden_size"])
+    return Qwen3VLVisionSpec(
+        depth=int(vc["depth"]),
+        embed_dim=embed,
+        num_heads=int(vc["num_heads"]),
+        mlp_hidden=int(vc.get("intermediate_size", embed * 4)),
+        patch_input=(int(vc.get("in_channels", 3))
+                     * int(vc.get("temporal_patch_size", 2))
+                     * int(vc["patch_size"]) ** 2),
+        spatial_merge=int(vc.get("spatial_merge_size", 2)),
+        out_hidden=int(vc["out_hidden_size"]),
+        num_pos=int(vc["num_position_embeddings"]),
+        deepstack_indexes=tuple(int(i)
+                                for i in vc["deepstack_visual_indexes"]),
+        act=str(vc.get("hidden_act", "gelu_pytorch_tanh")),
+    )
+
+
+def interp_pos_embed(spec: Qwen3VLVisionSpec, table: np.ndarray,
+                     grid_thw: np.ndarray) -> np.ndarray:
+    """Bilinear interpolation of the learned pos-embed table onto each
+    image's (h, w) grid, in the merge-block-permuted patch order (HF
+    fast_pos_embed_interpolate parity)."""
+    side = spec.grid_side
+    m = spec.spatial_merge
+    out = []
+    for t, h, w in np.asarray(grid_thw):
+        hi = np.linspace(0, side - 1, h)
+        wi = np.linspace(0, side - 1, w)
+        hf, wf = hi.astype(np.int64), wi.astype(np.int64)
+        hc = np.clip(hf + 1, None, side - 1)
+        wc = np.clip(wf + 1, None, side - 1)
+        dh, dw = hi - hf, wi - wf
+        e = (table[(hf[:, None] * side + wf[None, :])] *
+             ((1 - dh)[:, None, None] * (1 - dw)[None, :, None])
+             + table[(hf[:, None] * side + wc[None, :])] *
+             ((1 - dh)[:, None, None] * dw[None, :, None])
+             + table[(hc[:, None] * side + wf[None, :])] *
+             (dh[:, None, None] * (1 - dw)[None, :, None])
+             + table[(hc[:, None] * side + wc[None, :])] *
+             (dh[:, None, None] * dw[None, :, None]))        # (h, w, E)
+        e = np.tile(e.reshape(1, h, w, -1), (t, 1, 1, 1))
+        # merge-block permutation (same order the processor emits patches)
+        e = e.reshape(t, h // m, m, w // m, m, -1).transpose(0, 1, 3, 2, 4, 5)
+        out.append(e.reshape(t * h * w, -1))
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+_ACTS = {
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "silu": jax.nn.silu,
+}
+
+
+def qwen3vl_vision_forward(spec: Qwen3VLVisionSpec, params: Dict[str, Any],
+                           patches: jnp.ndarray, pos_embeds: jnp.ndarray,
+                           cos: jnp.ndarray, sin: jnp.ndarray,
+                           image_ids: jnp.ndarray
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """patches (N, patch_input); pos_embeds (N, E) interpolated; cos/sin
+    (N, head_dim/2); image_ids (N,). Returns (merged (N/m^2, out_hidden),
+    deepstack (K, N/m^2, out_hidden))."""
+    n = patches.shape[0]
+    nh, hd = spec.num_heads, spec.head_dim
+    act = _ACTS.get(spec.act, _ACTS["gelu_pytorch_tanh"])
+    x = patches @ params["patch_proj"] + params["patch_bias"]
+    x = x + pos_embeds.astype(x.dtype)
+    block_mask = (image_ids[:, None] == image_ids[None, :])
+
+    def rope(t):
+        tf = t.astype(jnp.float32)
+        d2 = cos.shape[-1]
+        t1, t2 = tf[..., :d2], tf[..., d2:]
+        c, s = cos[:, None, :], sin[:, None, :]
+        return jnp.concatenate([t1 * c - t2 * s, t2 * c + t1 * s],
+                               axis=-1).astype(t.dtype)
+
+    def block(h, lw):
+        r = layer_norm(h, lw["ln1_w"], lw["ln1_b"], spec.eps)
+        qkv = r @ lw["qkv_w"] + lw["qkv_b"]
+        q, k, v = jnp.split(qkv.reshape(n, 3, nh, hd), 3, axis=1)
+        q, k, v = rope(q[:, 0]), rope(k[:, 0]), v[:, 0]
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        s = jnp.where(block_mask[None], s, -1e30)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
+        h = h + (a.reshape(n, -1).astype(h.dtype) @ lw["proj_w"]
+                 + lw["proj_b"])
+        r = layer_norm(h, lw["ln2_w"], lw["ln2_b"], spec.eps)
+        m = act(r @ lw["fc1_w"] + lw["fc1_b"])
+        return h + (m @ lw["fc2_w"] + lw["fc2_b"])
+
+    def merger(h, mw, postshuffle):
+        m2 = spec.spatial_merge ** 2
+        if postshuffle:
+            h = h.reshape(n // m2, -1)
+            h = layer_norm(h, mw["norm_w"], mw["norm_b"], spec.eps)
+        else:
+            h = layer_norm(h, mw["norm_w"], mw["norm_b"], spec.eps)
+            h = h.reshape(n // m2, -1)
+        h = jax.nn.gelu(h @ mw["fc1_w"] + mw["fc1_b"], approximate=False)
+        return h @ mw["fc2_w"] + mw["fc2_b"]
+
+    deepstack = []
+    for i in range(spec.depth):
+        lw = jax.tree.map(lambda a, i=i: a[i], params["layers"])
+        x = block(x, lw)
+        if i in spec.deepstack_indexes:
+            k = spec.deepstack_indexes.index(i)
+            mw = jax.tree.map(lambda a, k=k: a[k], params["deepstack_mergers"])
+            deepstack.append(merger(x, mw, postshuffle=True))
+    out = merger(x, params["merger"], postshuffle=False)
+    return out, jnp.stack(deepstack)
+
+
+def convert_qwen3vl_vision(sd: Dict[str, np.ndarray],
+                           spec: Qwen3VLVisionSpec,
+                           prefix: str = "visual") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"blocks.{i}"
+        return {
+            "ln1_w": get(f"{b}.norm1.weight"), "ln1_b": get(f"{b}.norm1.bias"),
+            "qkv_w": t(get(f"{b}.attn.qkv.weight")),
+            "qkv_b": get(f"{b}.attn.qkv.bias"),
+            "proj_w": t(get(f"{b}.attn.proj.weight")),
+            "proj_b": get(f"{b}.attn.proj.bias"),
+            "ln2_w": get(f"{b}.norm2.weight"), "ln2_b": get(f"{b}.norm2.bias"),
+            "fc1_w": t(get(f"{b}.mlp.linear_fc1.weight")),
+            "fc1_b": get(f"{b}.mlp.linear_fc1.bias"),
+            "fc2_w": t(get(f"{b}.mlp.linear_fc2.weight")),
+            "fc2_b": get(f"{b}.mlp.linear_fc2.bias"),
+        }
+
+    def merger(base):
+        return {
+            "norm_w": get(f"{base}.norm.weight"),
+            "norm_b": get(f"{base}.norm.bias"),
+            "fc1_w": t(get(f"{base}.linear_fc1.weight")),
+            "fc1_b": get(f"{base}.linear_fc1.bias"),
+            "fc2_w": t(get(f"{base}.linear_fc2.weight")),
+            "fc2_b": get(f"{base}.linear_fc2.bias"),
+        }
+
+    layers = [lw(i) for i in range(spec.depth)]
+    mergers = [merger(f"deepstack_merger_list.{k}")
+               for k in range(len(spec.deepstack_indexes))]
+    return {
+        "patch_proj": t(get("patch_embed.proj.weight").reshape(
+            spec.embed_dim, -1)),
+        "patch_bias": get("patch_embed.proj.bias"),
+        "pos_table": get("pos_embed.weight"),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+        "merger": merger("merger"),
+        "deepstack_mergers": {k: np.stack([d[k] for d in mergers])
+                              for k in mergers[0]},
+    }
+
+
+class Qwen3VLInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "image_token_id"]
+
+    def get_text_config(self) -> InferenceConfig:
+        tc = dict(self.text_config)
+        tc.setdefault("model_type", "qwen3")
+        return Qwen3VLTextConfig(self.tpu_config, **tc)
+
+
+class Qwen3VLTextConfig(Qwen3InferenceConfig):
+    pass
+
+
+@register_family("qwen3_vl_text")
+class Qwen3VLTextFamily(Qwen3Family):
+    """Text decoder = qwen3 + interleaved mrope (set via rope_scaling)."""
+    config_cls = Qwen3VLTextConfig
+
+
+class Qwen3VLApplication:
+    """Vision tower + deepstack + interleaved-M-RoPE text LM (reference:
+    models/qwen3_vl/ model set)."""
+
+    family = Qwen3VLTextFamily
+
+    def __init__(self, model_path: Optional[str],
+                 config: Qwen3VLInferenceConfig, mesh=None):
+        from ..application import CausalLMApplication
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        Qwen3VLTextFamily, mesh=mesh)
+        assert self.text.spec.rope.mrope_interleaved or \
+            self.text.spec.rope.mrope_section is None
+        self.vision_spec = qwen3vl_vision_spec(dict(config.vision_config))
+        self.image_token_id = int(config.image_token_id)
+        self.spatial_merge = self.vision_spec.spatial_merge
+        self.vision_params = None
+        self._vis_fn = jax.jit(partial(qwen3vl_vision_forward,
+                                       self.vision_spec))
+
+    def load_weights(self):
+        from ...utils import checkpoint as ckpt
+        sd = ckpt.load_state_dict(self.model_path)
+        remap = {}
+        for k, v in sd.items():
+            k2 = k.replace("model.language_model.", "model.")
+            k2 = k2.replace("model.visual.", "visual.")
+            remap[k2] = v
+        host = self.family.convert_hf_state_dict(remap, self.text.spec)
+        self.text._put_params(host)
+        self.vision_params = jax.tree.map(
+            jnp.asarray, convert_qwen3vl_vision(remap, self.vision_spec))
+        self._pos_table = np.asarray(self.vision_params["pos_table"])
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def encode_images(self, pixel_patches: np.ndarray, grid_thw: np.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(N, patch_input) + (n_imgs, 3) -> (merged (N/m^2, H_text),
+        deepstack (K, N/m^2, H_text))."""
+        ang = vision_rot_angles(grid_thw, self.vision_spec)
+        pos = interp_pos_embed(self.vision_spec, self._pos_table, grid_thw)
+        ids = np.repeat(np.arange(len(grid_thw)),
+                        [int(t * h * w) for t, h, w in np.asarray(grid_thw)])
+        return self._vis_fn(self.vision_params, jnp.asarray(pixel_patches),
+                            jnp.asarray(pos),
+                            jnp.asarray(np.cos(ang)), jnp.asarray(np.sin(ang)),
+                            jnp.asarray(ids))
+
+    def generate(self, input_ids: np.ndarray,
+                 pixel_patches: Optional[np.ndarray] = None,
+                 image_grid_thw: Optional[np.ndarray] = None,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32, **kw) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        image_embeds = image_mask = deepstack = None
+        rope_pos = decode_start = None
+        if pixel_patches is not None:
+            feats, ds = self.encode_images(pixel_patches, image_grid_thw)
+            image_mask = input_ids == self.image_token_id
+            per_row = image_mask.sum(axis=1)
+            if not (per_row == per_row[0]).all():
+                raise ValueError("rows must hold equal image-token counts")
+            image_embeds = np.asarray(feats).reshape(b, per_row[0], -1)
+            deepstack = np.asarray(ds).reshape(ds.shape[0], b, per_row[0], -1)
+            rope_pos, decode_start = get_rope_index(
+                input_ids, image_grid_thw, self.image_token_id,
+                self.spatial_merge, attention_mask)
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens, image_embeds=image_embeds,
+            image_mask=image_mask, deepstack_embeds=deepstack,
+            rope_position_ids=rope_pos, decode_rope_start=decode_start, **kw)
+
+    def reset(self):
+        self.text.reset()
+        return self

@@ -423,6 +423,13 @@ class EagleDecoder:
 def medusa_propose(spec: DecoderSpec, params, hidden, top_k: int = 1):
     """Run the medusa heads on (B,H) features: head j = ResBlock + lm head
     predicting position +j+2. Returns (B, M, top_k) token ids."""
+    return medusa_propose_scored(spec, params, hidden, top_k)[0]
+
+
+def medusa_propose_scored(spec: DecoderSpec, params, hidden, top_k: int = 1):
+    """medusa_propose returning (ids (B,M,k), logprobs (B,M,k)) — the
+    per-level scores feeding dynamic tree construction (reference:
+    modules/eagle/dynamic_token_tree.py candidate scoring)."""
     h = hidden[:, None, :]                                   # (B,1,H)
     r = h + jax.nn.silu(
         jnp.einsum("bmh,mhk->bmk", jnp.broadcast_to(
@@ -430,8 +437,9 @@ def medusa_propose(spec: DecoderSpec, params, hidden, top_k: int = 1):
             params["medusa_blocks"]) + params["medusa_bias"])
     logits = jnp.einsum("bmh,mhv->bmv", r, params["medusa_lm"])
     logits = logits[..., :spec.vocab_size].astype(jnp.float32)
-    _, idx = jax.lax.top_k(logits, top_k)
-    return idx.astype(jnp.int32)                             # (B,M,top_k)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    top_lp, idx = jax.lax.top_k(logp, top_k)
+    return idx.astype(jnp.int32), top_lp                     # (B,M,k) each
 
 
 def medusa_speculation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
@@ -709,3 +717,209 @@ class MedusaTreeDecoder:
             "mean_tokens_per_step": (float(np.mean(np.concatenate(
                 emitted_counts))) if emitted_counts else 0.0),
         }
+
+
+# ===========================================================================
+# Dynamic token tree (reference: modules/eagle/dynamic_token_tree.py, 352
+# LoC — EAGLE-2-style): instead of a FIXED tree shape, each step selects the
+# top-``num_nodes`` lattice nodes by cumulative joint log-probability. The
+# candidate lattice is the full k-ary tree of the proposal depth (static
+# tables); selection is in-graph. Joint scores are monotone non-increasing
+# along a path, so the top-N set is automatically ancestor-closed.
+# ===========================================================================
+
+def build_lattice(branch_k: int, depth: int):
+    """Static numpy tables for the full k-ary lattice: depth (N,),
+    parent (N,), branch (N,), ancestor (N,N) incl. self, path (N, depth+1)
+    lattice ids from root (-1 padded)."""
+    nodes = [()]
+    for d in range(depth):
+        nodes += [p + (b,) for p in nodes if len(p) == d
+                  for b in range(branch_k)]
+    nodes = sorted(nodes, key=lambda p: (len(p), p))
+    idx = {p: i for i, p in enumerate(nodes)}
+    n = len(nodes)
+    dep = np.array([len(p) for p in nodes], np.int32)
+    par = np.array([idx[p[:-1]] if p else 0 for p in nodes], np.int32)
+    br = np.array([p[-1] if p else 0 for p in nodes], np.int32)
+    anc = np.zeros((n, n), bool)
+    path = np.full((n, depth + 1), -1, np.int32)
+    for i, p in enumerate(nodes):
+        for d in range(len(p) + 1):
+            anc[i, idx[p[:d]]] = True
+            path[i, d] = idx[p[:d]]
+    return dep, par, br, anc, path
+
+
+def dynamic_tree_select(lat, prop_logp, num_nodes: int):
+    """Select the top-``num_nodes`` lattice nodes by joint logprob.
+    prop_logp (B, D, k). Returns sel (B, M) lattice ids (root first,
+    depth-sorted) and their scores."""
+    dep, par, br, anc, path = lat
+    b = prop_logp.shape[0]
+    n = dep.shape[0]
+    # node score = sum of branch logprobs along the path
+    edge_lp = jnp.where(
+        dep[None, :] > 0,
+        prop_logp[:, jnp.maximum(dep - 1, 0), br],           # (B, N)
+        0.0)
+    # accumulate over ancestors (anc includes self; root contributes 0)
+    score = jnp.einsum("bn,mn->bm", edge_lp,
+                       jnp.asarray(anc, jnp.float32))        # (B, N)
+    _, sel = jax.lax.top_k(score, num_nodes)
+    # stable depth-major order (root at slot 0)
+    order = jnp.argsort(dep[sel] * n + sel, axis=-1)
+    sel = jnp.take_along_axis(sel, order, axis=-1)
+    return sel, jnp.take_along_axis(score, sel, axis=-1)
+
+
+def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
+                             cache, root, prop_toks, prop_logp, base_pos,
+                             seq_ids, lat_dep, lat_par, lat_br, lat_anc,
+                             lat_path, num_nodes: int, cache_len: int):
+    """One dynamic-tree verify step: build the tree in-graph from the
+    proposal scores, verify, accept the deepest fully-matching path.
+    root (B,) last emitted token; prop_toks/prop_logp (B, D, k)."""
+    b = root.shape[0]
+    n_lat = lat_dep.shape[0]
+    sel, _ = dynamic_tree_select(
+        (lat_dep, lat_par, lat_br, lat_anc, lat_path), prop_logp, num_nodes)
+    m = sel.shape[1]
+    dep_s = lat_dep[sel]                                      # (B, M)
+    # node tokens: lattice node -> proposal token at (depth-1, branch)
+    tok_lat = jnp.where(
+        lat_dep[None, :] > 0,
+        prop_toks[:, jnp.maximum(lat_dep - 1, 0), lat_br],    # (B, N)
+        root[:, None])
+    node_toks = jnp.take_along_axis(tok_lat, sel, axis=-1)    # (B, M)
+    # ancestor relation among SELECTED nodes + committed-prefix mask
+    anc_pair = jnp.asarray(lat_anc)[sel[:, :, None], sel[:, None, :]]
+    slot = jnp.arange(cache_len, dtype=base_pos.dtype)[None, None, :]
+    committed = slot < base_pos[:, None, None]                # (B, M, S)
+    node_slot = base_pos[:, None] + jnp.arange(m, dtype=base_pos.dtype)
+    tree_part = jnp.zeros((b, m, cache_len), bool).at[
+        jnp.arange(b)[:, None, None], jnp.arange(m)[None, :, None],
+        node_slot[:, None, :]].max(anc_pair)
+    mask = committed | tree_part
+
+    rope_pos = base_pos[:, None] + dep_s
+    write_pos = node_slot
+    out = tree_forward(spec, tpu_cfg, params, cache, node_toks, rope_pos,
+                       write_pos, seq_ids, mask)
+    greedy = jnp.argmax(out["logits_all"], axis=-1).astype(jnp.int32)
+
+    # selection-index of each node's parent: inverse map lattice id -> slot
+    inv = jnp.full((b, n_lat), 0, jnp.int32).at[
+        jnp.arange(b)[:, None], sel].set(
+        jnp.broadcast_to(jnp.arange(m, dtype=jnp.int32)[None], (b, m)))
+    par_slot = jnp.take_along_axis(inv, lat_par[sel], axis=-1)  # (B, M)
+    pred_at_parent = jnp.take_along_axis(greedy, par_slot, axis=-1)
+    edge_ok = jnp.where(dep_s > 0, node_toks == pred_at_parent, True)
+    # chain: every selected ancestor's edge matches
+    chain = jnp.all(~anc_pair | edge_ok[:, None, :], axis=-1)  # (B, M)
+    cand_depth = jnp.where(chain, dep_s, -1)
+    best = jnp.argmax(cand_depth, axis=-1).astype(jnp.int32)   # (B,)
+    n_acc = jnp.take_along_axis(dep_s, best[:, None], 1)[:, 0]
+    bonus = jnp.take_along_axis(greedy, best[:, None], 1)[:, 0]
+
+    # accepted path tokens: lattice path of best -> selection slots -> toks
+    best_lat = jnp.take_along_axis(sel, best[:, None], 1)[:, 0]
+    path_lat = jnp.maximum(jnp.asarray(lat_path)[best_lat], 0)  # (B, D+1)
+    path_slot = jnp.take_along_axis(inv, path_lat, axis=-1)
+    path_toks = jnp.take_along_axis(node_toks, path_slot, axis=-1)
+    d1 = path_toks.shape[1]
+    idx = jnp.arange(d1, dtype=jnp.int32)[None, :]
+    shifted = jnp.concatenate(
+        [path_toks[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+    tokens = jnp.where(idx < n_acc[:, None], shifted,
+                       jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
+    feat = jnp.take_along_axis(
+        out["hidden"], best[:, None, None], axis=1)[:, 0]
+
+    # cache refresh: linearize [root, accepted..., bonus]
+    refresh_toks = jnp.concatenate([root[:, None], tokens], axis=1)
+    r_w = refresh_toks.shape[1]
+    ridx = jnp.arange(r_w, dtype=jnp.int32)[None, :]
+    rpos = base_pos[:, None] + ridx
+    rpos = jnp.where(ridx <= (n_acc + 1)[:, None], rpos,
+                     kv_mod.cache_len_of(out["cache"]))
+    upd = model_base.token_generation_multi(
+        spec, tpu_cfg, params, out["cache"], refresh_toks, rpos, seq_ids)
+    return {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,
+            "feature": feat, "cache": upd["cache"]}
+
+
+class DynamicTreeDecoder:
+    """Host loop for DYNAMIC-tree medusa speculation (reference:
+    modules/eagle/dynamic_token_tree.py): per step the tree shape follows
+    the proposal distribution instead of a fixed template."""
+
+    def __init__(self, target_app, branch_k: int = 4,
+                 num_nodes: int = 16):
+        self.target = target_app
+        cfg = target_app.tpu_config
+        if target_app.spec.medusa_heads < 1:
+            raise ValueError("medusa heads required")
+        self.depth = target_app.spec.medusa_heads
+        self.branch_k = branch_k
+        self.num_nodes = num_nodes
+        dep, par, br, anc, path = build_lattice(branch_k, self.depth)
+        if num_nodes > dep.shape[0]:
+            raise ValueError("num_nodes exceeds the candidate lattice")
+        self._lat = tuple(jnp.asarray(x) for x in (dep, par, br, anc, path))
+        self._step = jax.jit(
+            partial(dynamic_medusa_tree_step, target_app.spec, cfg,
+                    num_nodes=num_nodes, cache_len=cfg.seq_len),
+            donate_argnums=(1,))
+        self._propose = jax.jit(
+            partial(medusa_propose_scored, target_app.spec),
+            static_argnames=("top_k",))
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None):
+        input_ids = np.asarray(input_ids).astype(np.int32)
+        b, s = input_ids.shape
+        seq_lens = np.full((b,), s, np.int32)
+        seq_ids = jnp.arange(b, dtype=jnp.int32)
+        t_out = self.target._run_prefill(input_ids, seq_lens)
+        root = jnp.asarray(np.asarray(t_out["tokens"]).astype(np.int32))
+        ptoks, plogp = self._propose(self.target.params,
+                                     t_out["last_hidden"],
+                                     top_k=self.branch_k)
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        out_rows = [[int(np.asarray(root)[i])] for i in range(b)]
+        positions = seq_lens.copy()
+        done = np.zeros((b,), bool)
+        emitted_counts = []
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + self.num_nodes + 1
+               < self.target.tpu_config.seq_len
+               and not done.all()):
+            res = self._step(self.target.params, self.target.cache, root,
+                             ptoks, plogp, jnp.asarray(positions), seq_ids,
+                             *self._lat)
+            self.target.cache = res["cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_emitted"])
+            emitted_counts.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                for tk in toks[i, :n_emit[i]].tolist():
+                    out_rows[i].append(int(tk))
+                    if eos_set is not None and int(tk) in eos_set:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            root = res["bonus"]
+            ptoks, plogp = self._propose(self.target.params, res["feature"],
+                                         top_k=self.branch_k)
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+        return {"generated": gen,
+                "sequences": np.concatenate([input_ids, gen], axis=1),
+                "mean_accept": (float(np.mean(np.concatenate(emitted_counts)))
+                                if emitted_counts else 0.0)}

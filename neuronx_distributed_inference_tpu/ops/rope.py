@@ -48,6 +48,10 @@ class RopeConfig:
     # angle from the axis owning it — slots [0,s0) temporal, [s0,s0+s1)
     # height, [s0+s1,s0+s1+s2) width. sum(mrope_section) == dim/2.
     mrope_section: Optional[Tuple[int, ...]] = None
+    # Qwen3-VL interleaved M-RoPE (reference: models/qwen3_vl/ — HF
+    # apply_interleaved_mrope): slots cycle T,H,W,T,H,W,... up to 3*sec_h /
+    # 3*sec_w for H/W, preserving frequency continuity; the tail stays T
+    mrope_interleaved: bool = False
 
     @property
     def dim(self) -> int:
@@ -134,8 +138,19 @@ def rope_cos_sin(position_ids: jnp.ndarray, cfg: RopeConfig
     if cfg.mrope_section is not None and position_ids.ndim == 3:
         angles3 = (position_ids.astype(jnp.float32)[..., None]
                    * inv_freq)                     # (B, S, 3, d/2)
-        axis_of_slot = sum(([ax] * n for ax, n in
-                            enumerate(cfg.mrope_section)), [])
+        if cfg.mrope_interleaved:
+            sec = cfg.mrope_section
+            axis_of_slot = []
+            for i in range(sum(sec)):
+                if i % 3 == 1 and i < 3 * sec[1]:
+                    axis_of_slot.append(1)
+                elif i % 3 == 2 and i < 3 * sec[2]:
+                    axis_of_slot.append(2)
+                else:
+                    axis_of_slot.append(0)
+        else:
+            axis_of_slot = sum(([ax] * n for ax, n in
+                                enumerate(cfg.mrope_section)), [])
         sel = jnp.asarray(np_one_hot(axis_of_slot, angles3.shape[2]))
         angles = jnp.einsum("bsad,da->bsd", angles3, sel)
     else:

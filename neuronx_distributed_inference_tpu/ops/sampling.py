@@ -117,3 +117,39 @@ def sample(logits: jnp.ndarray, config: Optional[OnDeviceSamplingConfig],
     if squeeze:
         toks = toks.reshape(squeeze)
     return toks
+
+
+def sample_dp(logits: jnp.ndarray, config, sampling_params, key,
+              mesh=None) -> jnp.ndarray:
+    """Batch-sharded sampling (reference: modules/generation/sampling.py
+    :467-578 ``DataParallelSampler``): shard_map :func:`sample` over the
+    mesh "dp" axis so each shard runs top-k on its own batch slice —
+    the (B, V) logits are never gathered. Falls back to the global
+    :func:`sample` when no dp axis is active or B doesn't divide."""
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+    b = logits.shape[0]
+    if (mesh is None or "dp" not in getattr(mesh, "axis_names", ())
+            or mesh.shape["dp"] <= 1 or b % mesh.shape["dp"] != 0):
+        return sample(logits, config, sampling_params, key)
+    from jax.sharding import PartitionSpec as P
+    dp = mesh.shape["dp"]
+    specs = [P("dp")]
+    args = [logits]
+    if sampling_params is not None:
+        specs.append(P("dp") if sampling_params.shape[0] == b else P())
+        args.append(sampling_params)
+    if key is not None:
+        # fold the shard index into the key so shards draw independent noise
+        specs.append(P())
+        args.append(key)
+
+    def body(lg, *rest):
+        sp = rest[0] if sampling_params is not None else None
+        k = rest[-1] if key is not None else None
+        if k is not None:
+            k = jax.random.fold_in(k, jax.lax.axis_index("dp"))
+        return sample(lg, config, sp, k)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=tuple(specs),
+                         out_specs=P("dp"), check_vma=False)(*args)

@@ -54,3 +54,61 @@ def test_json_round_trip(tmp_path):
 def test_unknown_keys_warn_not_raise():
     c = TpuConfig.from_dict({"batch_size": 1, "definitely_not_a_knob": 7})
     assert c.batch_size == 1
+
+
+def test_dead_knobs_raise_or_work():
+    """Every accepted knob changes behavior or errors (reference parity
+    audit): pp_degree raises (no inference pipeline schedule), mlp_cp
+    requires SP+cp, vocab_parallel switches the embed sharding."""
+    import pytest
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    with pytest.raises(ValueError, match="pp_degree"):
+        TpuConfig(pp_degree=2, tp_degree=2)
+    with pytest.raises(ValueError, match="mlp_cp_degree"):
+        TpuConfig(mlp_cp_degree=2, tp_degree=4)
+    # valid mlp-cp spelling: sequence parallel over the cp axis
+    TpuConfig(mlp_cp_degree=2, cp_degree=2, tp_degree=4,
+              sequence_parallel_enabled=True)
+
+
+def test_vocab_parallel_controls_embed_sharding():
+    from jax.sharding import PartitionSpec as P
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models import model_base
+    from conftest import tiny_llama_hf_config
+    from neuronx_distributed_inference_tpu.models.llama import \
+        LlamaInferenceConfig
+
+    def embed_pspec(vocab_parallel):
+        tcfg = TpuConfig(tp_degree=2, vocab_parallel=vocab_parallel)
+        icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+        spec = model_base.spec_from_config(icfg)
+        return model_base.decoder_param_specs(spec)["embed"].pspec
+
+    assert embed_pspec(True) == P(("ep", "tp"), None)
+    assert embed_pspec(False) == P()
+
+
+def test_save_converted_checkpoint_roundtrip(tmp_path):
+    import numpy as np
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        CausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from conftest import tiny_llama_hf_config
+    tcfg = TpuConfig(batch_size=2, seq_len=32, dtype="float32",
+                     enable_bucketing=False, save_sharded_checkpoint=True)
+    icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+    app = CausalLMApplication(None, icfg, LlamaFamily)
+    app.init_random_weights(seed=3)
+    app.init_cache()
+    prompt = np.arange(1, 9, dtype=np.int32)[None].repeat(2, 0)
+    ref = app.generate(prompt, max_new_tokens=4)["sequences"]
+    app.compile(str(tmp_path / "artifact"))   # saves the converted ckpt
+
+    app2 = CausalLMApplication(None, icfg, LlamaFamily)
+    app2.load_converted_checkpoint(str(tmp_path / "artifact"))
+    app2.init_cache()
+    got = app2.generate(prompt, max_new_tokens=4)["sequences"]
+    np.testing.assert_array_equal(got, ref)

@@ -155,3 +155,44 @@ def test_medusa_tree_matches_plain_greedy(rng):
     out = dec.generate(prompts, max_new_tokens=16)
     np.testing.assert_array_equal(out["generated"], golden)
     assert out["mean_tokens_per_step"] >= 1.0
+
+
+def test_dynamic_tree_matches_plain_greedy(rng):
+    """Dynamic token tree (reference: modules/eagle/dynamic_token_tree.py —
+    EAGLE-2-style top-N-by-joint-logprob node selection over the proposal
+    lattice): emitted tokens must equal plain greedy decode."""
+    from neuronx_distributed_inference_tpu.models.speculation import (
+        DynamicTreeDecoder, build_lattice)
+    dep, par, br, anc, path = build_lattice(3, 2)
+    assert dep.shape[0] == 1 + 3 + 9
+    assert anc[4, 1] and not anc[4, 2]     # node 4 = child of node 1
+    prompts = rng.integers(1, 500, size=(2, 10)).astype(np.int32)
+    golden = _plain_greedy(prompts, 16)
+    spec_cfg = SpeculationConfig(medusa_speculation_length=4,
+                                 num_medusa_heads=3)
+    target = _target_app(spec_cfg=spec_cfg, medusa_heads=3)
+    dec = DynamicTreeDecoder(target, branch_k=3, num_nodes=10)
+    out = dec.generate(prompts, max_new_tokens=16)
+    np.testing.assert_array_equal(out["generated"], golden)
+    assert out["mean_accept"] >= 1.0
+
+
+def test_data_parallel_sampler_matches_global():
+    """sample_dp (reference: DataParallelSampler, sampling.py:467-578):
+    batch-sharded top-k over the dp axis equals the global sampler."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+    from neuronx_distributed_inference_tpu.ops import sampling as S
+    devs = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devs, ("dp", "tp"))
+    logits = jnp.asarray(
+        np.random.default_rng(0).standard_normal((8, 64)), jnp.float32)
+    sp = S.prepare_sampling_params(8, top_k=4, top_p=0.9, temperature=1.0)
+    cfg = OnDeviceSamplingConfig(do_sample=True, deterministic=True)
+    with jax.sharding.set_mesh(mesh):
+        got = jax.jit(lambda lg, s: S.sample_dp(lg, cfg, s, None))(
+            logits, jnp.asarray(sp))
+    want = S.sample(logits, cfg, jnp.asarray(sp), None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

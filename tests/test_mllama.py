@@ -93,6 +93,83 @@ def test_mllama_text_matches_hf(hf_mllama_text):
     assert not mism.any()
 
 
+def test_mllama_vision_pixels_to_tokens(tmp_path):
+    """Full image->text path: tiled vision tower + gated embeddings +
+    projector + cross-attention decode vs HF MllamaForConditionalGeneration
+    (reference: modeling_mllama_vision.py + image_transform.py parity)."""
+    from transformers import MllamaConfig, MllamaForConditionalGeneration
+    from transformers.models.mllama.configuration_mllama import (
+        MllamaTextConfig, MllamaVisionConfig)
+    torch.manual_seed(2)
+    vcfg = MllamaVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=3,
+        num_global_layers=2, attention_heads=4, image_size=16, patch_size=8,
+        num_channels=3, max_num_tiles=4, intermediate_layers_indices=[1, 2],
+        vision_output_dim=96,     # hidden * (1 + 2 intermediate)
+        supported_aspect_ratios=[[1, 1], [1, 2], [2, 1], [2, 2]])
+    tcfg_hf = MllamaTextConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=300,
+        rms_norm_eps=1e-5, max_position_embeddings=256, rope_theta=10000.0,
+        cross_attention_layers=[1, 3], tie_word_embeddings=False,
+        pad_token_id=0, rope_scaling={"rope_type": "default"},
+        torch_dtype="float32")
+    cfg = MllamaConfig(vision_config=vcfg, text_config=tcfg_hf,
+                       image_token_index=299)
+    m = MllamaForConditionalGeneration(cfg)
+    m.eval()
+    m.generation_config.eos_token_id = None
+    d = str(tmp_path / "mllama_vl")
+    m.save_pretrained(d, safe_serialization=True)
+
+    rng = np.random.default_rng(7)
+    B, S = 1, 8
+    # one image, 2 of 4 tiles live (aspect ratio [1,2] -> id 2)
+    pixels = np.zeros((B, 1, 4, 3, 16, 16), np.float32)
+    pixels[:, :, :2] = rng.standard_normal((B, 1, 2, 3, 16, 16))
+    ar_ids = np.array([[2]], np.int64)
+    ar_mask = np.array([[[1, 1, 0, 0]]], np.int64)
+    ids = np.concatenate([np.full((B, 1), 299),
+                          rng.integers(5, 295, (B, S - 1))], axis=1)
+    cam = np.zeros((B, S, 1, 4), np.int64)
+    cam[:, :, 0, :2] = 1                     # every text token sees tiles 0-1
+    with torch.no_grad():
+        hf_seq = m.generate(
+            input_ids=torch.tensor(ids), pixel_values=torch.tensor(pixels),
+            aspect_ratio_ids=torch.tensor(ar_ids),
+            aspect_ratio_mask=torch.tensor(ar_mask),
+            cross_attention_mask=torch.tensor(cam),
+            max_new_tokens=6, do_sample=False).numpy()
+
+    tcfg = TpuConfig(batch_size=B, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    app = MllamaApplication(d, type("C", (), {
+        "tpu_config": tcfg, "text_config": tcfg_hf.to_dict(),
+        "vision_config": vcfg.to_dict()})())
+    app.load_weights().init_cache()
+    out = app.generate_from_images(
+        ids.astype(np.int32), pixels, ar_ids, ar_mask,
+        cross_attention_mask=cam, max_new_tokens=6)
+    np.testing.assert_array_equal(out["generated"], hf_seq[:, S:])
+
+
+def test_image_to_tiles_roundtrip():
+    """Host aspect-ratio pipeline: canvas choice + tiling invariants
+    (reference: aspect_ratio_utils.py / image_transform.py)."""
+    from neuronx_distributed_inference_tpu.models.mllama.modeling_mllama \
+        import choose_canvas, image_to_tiles, supported_aspect_ratios
+    ars = supported_aspect_ratios(4)
+    assert (1, 1) in ars and (2, 2) in ars and (4, 1) in ars
+    assert (3, 2) not in ars                  # 6 tiles > max 4
+    # wide image -> wide canvas
+    assert choose_canvas(100, 300, 224, 4) in ((2, 1), (3, 1), (4, 1))
+    img = np.random.default_rng(0).standard_normal((3, 100, 300)).astype(
+        np.float32)
+    tiles, ar_id, n = image_to_tiles(img, 224, 4)
+    assert tiles.shape[1:] == (3, 224, 224)
+    assert tiles.shape[0] == n and 1 <= ar_id <= len(ars)
+
+
 def test_mllama_row_masked_out(hf_mllama_text):
     """Rows with no attendable vision tokens follow HF's uniform-attend +
     suppressed-MLP semantics."""

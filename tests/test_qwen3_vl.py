@@ -1,0 +1,109 @@
+"""Qwen3-VL golden tests: interleaved M-RoPE text + ViT with interpolated
+position embeddings + deepstack injection vs HF (reference:
+models/qwen3_vl/ — SURVEY §2.7)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.qwen3_vl import (
+    Qwen3VLApplication, Qwen3VLInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import Qwen3VLConfig, Qwen3VLForConditionalGeneration
+    torch.manual_seed(0)
+    cfg = Qwen3VLConfig(
+        text_config=dict(
+            hidden_size=64, intermediate_size=128, num_hidden_layers=3,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            vocab_size=300,
+            rope_scaling={"rope_type": "default", "mrope_section": [2, 3, 3],
+                          "mrope_interleaved": True},
+            rope_theta=10000.0, max_position_embeddings=256,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            torch_dtype="float32"),
+        vision_config=dict(
+            depth=3, hidden_size=32, num_heads=2, in_channels=3,
+            patch_size=4, spatial_merge_size=2, temporal_patch_size=2,
+            intermediate_size=64, out_hidden_size=64,
+            num_position_embeddings=16, deepstack_visual_indexes=[0, 1],
+            hidden_act="gelu_pytorch_tanh", torch_dtype="float32"),
+        image_token_id=7, vision_start_token_id=5, vision_end_token_id=6)
+    m = Qwen3VLForConditionalGeneration(cfg)
+    m.eval()
+    m.generation_config.eos_token_id = None
+    d = tmp_path_factory.mktemp("qwen3vl")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def _build_inputs(cfg, b=2, grid=(1, 4, 4), n_text=6):
+    rng = np.random.default_rng(0)
+    t, h, w = grid
+    merge = cfg.vision_config.spatial_merge_size
+    n_img_tok = t * (h // merge) * (w // merge)
+    row = ([5] + [7] * n_img_tok + [6]
+           + rng.integers(10, 290, n_text).tolist())
+    ids = np.stack([np.asarray(row)] * b)
+    ids[1, -n_text:] = rng.integers(10, 290, n_text)
+    patch_dim = (cfg.vision_config.in_channels
+                 * cfg.vision_config.temporal_patch_size
+                 * cfg.vision_config.patch_size ** 2)
+    patches = rng.normal(size=(b * t * h * w, patch_dim)).astype(np.float32)
+    grid_thw = np.asarray([[t, h, w]] * b)
+    return ids.astype(np.int64), patches, grid_thw
+
+
+def test_qwen3_vl_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    ids, patches, grid_thw = _build_inputs(cfg)
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Qwen3VLInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        image_token_id=cfg.image_token_id, model_type="qwen3_vl")
+    app = Qwen3VLApplication(d, icfg).load_weights().init_cache()
+    assert app.text.spec.rope.mrope_interleaved
+
+    # vision tower golden (merged features + deepstack feature list)
+    with torch.no_grad():
+        hf_feats, hf_ds = m.model.visual(torch.tensor(patches),
+                                         grid_thw=torch.tensor(grid_thw))
+    got_feats, got_ds = app.encode_images(patches, grid_thw)
+    np.testing.assert_allclose(np.asarray(got_feats), hf_feats.numpy(),
+                               atol=2e-4, rtol=1e-3)
+    for k in range(len(hf_ds)):
+        np.testing.assert_allclose(np.asarray(got_ds[k]), hf_ds[k].numpy(),
+                                   atol=2e-4, rtol=1e-3,
+                                   err_msg=f"deepstack {k}")
+
+    # end-to-end greedy generation golden (exercises deepstack injection)
+    with torch.no_grad():
+        hf_seq = m.generate(
+            input_ids=torch.tensor(ids),
+            pixel_values=torch.tensor(patches),
+            image_grid_thw=torch.tensor(grid_thw),
+            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixel_patches=patches,
+                       image_grid_thw=grid_thw, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+
+
+def test_interleaved_mrope_text_only_equals_plain():
+    """Text-only (t == h == w) interleaved M-RoPE must equal plain RoPE."""
+    import jax.numpy as jnp
+    from neuronx_distributed_inference_tpu.ops.rope import (RopeConfig,
+                                                            rope_cos_sin)
+    pos = np.arange(10)[None, :]
+    plain = RopeConfig(head_dim=16)
+    mr = RopeConfig(head_dim=16, mrope_section=(2, 3, 3),
+                    mrope_interleaved=True)
+    c0, s0 = rope_cos_sin(jnp.asarray(pos), plain)
+    pos3 = np.stack([pos] * 3, axis=-1)
+    c1, s1 = rope_cos_sin(jnp.asarray(pos3), mr)
+    np.testing.assert_allclose(np.asarray(c0), np.asarray(c1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1), atol=1e-6)

@@ -95,3 +95,4 @@ def test_speculation_with_eos_stops(target_dir, draft_dir):
     first_eos = row.index(eos)
     np.testing.assert_array_equal(row[:first_eos + 1],
                                   ref["generated"][0, :first_eos + 1].tolist())
+
