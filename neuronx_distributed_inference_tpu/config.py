@@ -233,6 +233,10 @@ class TpuConfig:
     kv_cache_batch_size: Optional[int] = None
     kv_cache_padding_size: int = 0
     is_block_kv_layout: bool = False
+    # rolling sliding-window KV cache (reference: kv_cache_manager.py:605-606
+    # pos %% (w-1) rolling write): cache holds only ``sliding_window`` slots.
+    # None = auto (on for uniform-window models without speculation/paged)
+    rolling_kv_cache: Optional[bool] = None
     pa_num_blocks: Optional[int] = None
     pa_block_size: int = 32
     is_prefix_caching: bool = False

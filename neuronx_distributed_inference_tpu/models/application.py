@@ -180,6 +180,10 @@ class CausalLMApplication:
             num_kv_heads=self.spec.gqa.num_kv_heads,
             head_dim=self.spec.head_dim,
             dtype=self.spec.kv_dtype,
+            # rolling sliding-window cache: w slots instead of seq_len
+            # (reference: kv_cache_manager.py:605-606)
+            window=(self.spec.sliding_window if self.spec.rolling_window
+                    else 0),
             v_head_dim=(self.spec.v_head_dim
                         if self.spec.v_head_dim != self.spec.head_dim else None),
         )
@@ -214,6 +218,8 @@ class CausalLMApplication:
         """Smallest TKG seq bucket covering ``needed`` cache slots — the
         decode graph compiled for bucket b reads cache[:b] only (reference:
         TKG seq buckets, autobucketing.py:226). None = full cache."""
+        if self.spec.rolling_window:
+            return None        # rolling cache: slot != position, no view cut
         buckets = self.tkg_buckets
         if len(buckets) <= 1:
             return None
@@ -398,6 +404,75 @@ class CausalLMApplication:
     # generation (reference: utils/hf_adapter.py _sample loop :139-258 +
     # NeuronBaseForCausalLM._get_model_outputs routing :3549-3735)
     # ------------------------------------------------------------------
+    def _generate_repadded(self, input_ids: np.ndarray, **kw
+                           ) -> Dict[str, Any]:
+        """Batch-mismatch host shim (reference: model_wrapper.py
+        ``_forward_with_pad`` :574-703 + sub-batching :1315-1440).
+
+        b < batch bucket: pad every batchful input by REPEATING ROW 0 —
+        pad rows recompute row 0's data and rewrite its cache rows with
+        identical values, so they are harmless (the reference repeats the
+        first batchline for exactly this reason); outputs are sliced back.
+        b > max compiled batch: split into compiled-batch sub-batches run
+        sequentially and re-concatenated. No seq_ids sort is needed: the
+        decode graph addresses cache rows BY seq_id (gather), so request
+        order is free."""
+        b_in = input_ids.shape[0]
+        cfg = self.tpu_config
+
+        def _batchful(x):
+            if x is None:
+                return False
+            a = np.asarray(x) if not hasattr(x, "shape") else x
+            return getattr(a, "ndim", 0) >= 1 and a.shape[0] == b_in
+
+        if b_in > cfg.batch_size:
+            # sub-batching: compiled-batch chunks (last padded recursively)
+            outs = []
+            for lo in range(0, b_in, cfg.batch_size):
+                hi = min(lo + cfg.batch_size, b_in)
+                sub = {k: (np.asarray(v)[lo:hi] if _batchful(v) else v)
+                       for k, v in kw.items()}
+                # deepstack stacks batch on axis 1
+                if kw.get("deepstack_embeds") is not None:
+                    sub["deepstack_embeds"] =                         np.asarray(kw["deepstack_embeds"])[:, lo:hi]
+                outs.append(self.generate(input_ids[lo:hi], **sub))
+            merged = {
+                "sequences": np.concatenate([o["sequences"] for o in outs]),
+                "generated": np.concatenate([o["generated"] for o in outs]),
+            }
+            for extra in ("ttft_s",):
+                if extra in outs[0]:
+                    merged[extra] = outs[0][extra]
+            if kw.get("return_logits") and "logits" in outs[0]:
+                merged["logits"] = [o["logits"] for o in outs]
+            return merged
+
+        pad = cfg.batch_size - b_in
+
+        def _pad0(x):
+            if not _batchful(x):
+                return x
+            a = np.asarray(x)
+            return np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+
+        kw2 = {k: _pad0(v) for k, v in kw.items()}
+        if kw.get("deepstack_embeds") is not None:
+            ds = np.asarray(kw["deepstack_embeds"])
+            kw2["deepstack_embeds"] = np.concatenate(
+                [ds, np.repeat(ds[:, :1], pad, axis=1)], axis=1)
+        padded_ids = np.concatenate(
+            [input_ids, np.repeat(input_ids[:1], pad, axis=0)])
+        out = self.generate(padded_ids, **kw2)
+        res = dict(out)
+        res["sequences"] = out["sequences"][:b_in]
+        res["generated"] = out["generated"][:b_in]
+        if "seq_lens" in out:
+            res["seq_lens"] = np.asarray(out["seq_lens"])[:b_in]
+        if "logits" in out:
+            res["logits"] = [np.asarray(lg)[:b_in] for lg in out["logits"]]
+        return res
+
     def generate(self, input_ids: np.ndarray,
                  attention_mask: Optional[np.ndarray] = None,
                  max_new_tokens: int = 128,
@@ -427,6 +502,20 @@ class CausalLMApplication:
         models/model_base.py:566-578). Decode advances all axes by 1/token."""
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
+        if b != self.tpu_config.batch_size:
+            # serving host shim (reference: model_wrapper.py:520-703
+            # repeat-first-batchline pad + :1315-1440 sub-batching): pad a
+            # short batch to the batch bucket by repeating row 0, or split
+            # an oversized batch into compiled-batch chunks
+            return self._generate_repadded(
+                input_ids, attention_mask=attention_mask,
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                sampling_params=sampling_params, return_logits=return_logits,
+                teacher_tokens=teacher_tokens, adapter_ids=adapter_ids,
+                image_embeds=image_embeds, image_mask=image_mask,
+                deepstack_embeds=deepstack_embeds,
+                rope_position_ids=rope_position_ids,
+                decode_rope_start=decode_rope_start)
         if adapter_ids is not None:
             adapter_ids = jnp.asarray(np.asarray(adapter_ids, np.int32))
         if attention_mask is None:
@@ -721,6 +810,27 @@ class PagedCausalLMApplication(CausalLMApplication):
         fn = partial(model_base.paged_forward_step, self.spec, self.tpu_config)
         return jax.jit(fn, donate_argnums=(1,))
 
+    def _jit_paged_loop(self, num_steps: int):
+        fn = partial(model_base.paged_decode_loop, self.spec, self.tpu_config,
+                     num_steps=num_steps)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _run_paged_loop(self, first_tokens, positions, block_table,
+                        num_steps: int, sampling_params=None):
+        key = ("paged_loop", num_steps)
+        if key not in self._compiled:
+            self._compiled[key] = self._jit_paged_loop(num_steps)
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(
+                first_tokens.shape[0])
+        with self._mesh_ctx():
+            out = self._compiled[key](
+                self.params, self.cache, jnp.asarray(first_tokens),
+                jnp.asarray(positions), jnp.asarray(block_table),
+                sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        return out
+
     def get_compiled(self, tag: str, bucket: int = 0):
         if tag == "paged_forward":
             key = (tag, bucket)
@@ -872,23 +982,44 @@ class PagedCausalLMApplication(CausalLMApplication):
         eos_seen = np.zeros((b,), bool) if eos_ids is not None else None
         if eos_seen is not None:
             eos_seen |= np.isin(tokens[:, 0], eos_ids)
+        # fetch-free chunked paged decode: blocks for the whole chunk are
+        # pre-allocated on the host, then ``decode_chunk_tokens`` steps run
+        # in ONE device call with slot mappings computed in-graph
+        # (model_base.paged_decode_loop; reference: in-graph tokengen
+        # slot-mapping, block_kv_cache_manager.py:376-430). Zero per-token
+        # host fetches; EOS is checked at chunk boundaries.
+        # return_logits keeps the single-step path (per-step logits).
+        chunk = 1 if return_logits else max(cfg.decode_chunk_tokens, 1)
         while n_generated < max_new_tokens:
-            if int(positions.max()) >= self.tpu_config.seq_len:
+            room = self.tpu_config.seq_len - int(positions.max())
+            remaining = min(max_new_tokens - n_generated, room)
+            # a partial chunk would jit a fresh ('paged_loop', n) graph
+            # mid-request — finish remainders with the single-step graph
+            steps = chunk if remaining >= chunk else 1
+            steps = min(steps, remaining)
+            if steps <= 0:
                 break
             for i in range(b):
-                self.kv_mgr.grow(i)
+                self.kv_mgr.grow(i, steps)
             bt = self.kv_mgr.block_table_array(range(b), self.max_blocks)
-            cur = collected[-1][:, -1:].astype(np.int32)
-            pos = positions[:, None]
-            slots = slots_from_table(bt, pos, self.kv_mgr.spec.block_size)
-            o = self._run_paged(cur, pos, slots, bt, np.zeros((b,), np.int32),
-                                sampling_params)
-            new = np.asarray(o["tokens"]).reshape(b, 1)
-            if return_logits and "logits" in o:
-                logits_trace.append(np.asarray(o["logits"]))
+            cur = collected[-1][:, -1].astype(np.int32)
+            if steps == 1:
+                pos = positions[:, None]
+                slots = slots_from_table(bt, pos,
+                                         self.kv_mgr.spec.block_size)
+                o = self._run_paged(cur[:, None], pos, slots, bt,
+                                    np.zeros((b,), np.int32),
+                                    sampling_params)
+                new = np.asarray(o["tokens"]).reshape(b, 1)
+                if return_logits and "logits" in o:
+                    logits_trace.append(np.asarray(o["logits"]))
+            else:
+                o = self._run_paged_loop(cur, positions, bt, steps,
+                                         sampling_params)
+                new = np.asarray(o["tokens"])
             collected.append(new)
-            positions = positions + 1
-            n_generated += 1
+            positions = positions + steps
+            n_generated += steps
             if eos_seen is not None:
                 eos_seen |= np.isin(new, eos_ids).any(axis=1)
                 if eos_seen.all():

@@ -87,6 +87,94 @@ class FluxPipeline:
         return out
 
 
+def _denoise(pipe: "FluxPipeline", x, ctx, pooled, img_ids, txt_ids, g,
+             sigmas, start: int,
+             known_packed=None, mask_packed=None, noise_packed=None):
+    """Euler flow-matching loop from step ``start``; optional inpaint
+    blending re-imposes the known region at each step's noise level
+    (reference: diffusers/flux/pipeline.py text2img/control/inpaint)."""
+    b = x.shape[0]
+    for i in range(start, len(sigmas) - 1):
+        t = jnp.full((b,), sigmas[i], jnp.float32)
+        v = pipe._flux(pipe.params, x, ctx, t, pooled, img_ids, txt_ids,
+                       guidance=g)
+        x = euler_step(x, v, float(sigmas[i]), float(sigmas[i + 1]))
+        if mask_packed is not None:
+            s_next = float(sigmas[i + 1])
+            known_noised = (1.0 - s_next) * known_packed                 + s_next * noise_packed
+            x = jnp.where(mask_packed, x, known_noised)
+    return x
+
+
+class FluxImg2ImgPipeline(FluxPipeline):
+    """Image-conditioned variants (reference: diffusers/flux/pipeline.py —
+    the control/img2img and inpaint pipelines named in BASELINE.json).
+    Both consume init LATENTS (B, C, h/8, w/8); VAE encoding happens
+    upstream."""
+
+    def img2img(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
+                init_latents: np.ndarray, strength: float = 0.6,
+                num_steps: int = 4, guidance: float = 3.5,
+                shift: float = 3.0, seed: int = 0,
+                decode: bool = True) -> Dict[str, Any]:
+        b = clip_ids.shape[0]
+        lat0 = jnp.asarray(init_latents, jnp.float32)
+        lh, lw = lat0.shape[2], lat0.shape[3]
+        ctx, pooled = self.encode_text(clip_ids, t5_ids)
+        sigmas = shifted_sigmas(num_steps, shift)
+        start = min(int(num_steps * (1.0 - strength)), num_steps - 1)
+        key = jax.random.PRNGKey(seed)
+        noise = jax.random.normal(key, lat0.shape, jnp.float32)
+        # flow-matching interpolation to the start noise level
+        s0 = float(sigmas[start])
+        x = ftx.pack_latents((1.0 - s0) * lat0 + s0 * noise)
+        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
+        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
+        g = jnp.full((b,), guidance, jnp.float32)
+        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas,
+                     start)
+        lat = ftx.unpack_latents(x, lh, lw)
+        out = {"latents": np.asarray(lat), "sigmas": sigmas,
+               "start_step": start}
+        if decode:
+            out["images"] = np.asarray(self._vae(self.vae_params, lat))
+        return out
+
+    def inpaint(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
+                init_latents: np.ndarray, mask: np.ndarray,
+                strength: float = 1.0, num_steps: int = 4,
+                guidance: float = 3.5, shift: float = 3.0, seed: int = 0,
+                decode: bool = True) -> Dict[str, Any]:
+        """mask (B, 1, h/8, w/8): True/1 = region to REGENERATE; the known
+        region is re-imposed at each step's noise level."""
+        b = clip_ids.shape[0]
+        lat0 = jnp.asarray(init_latents, jnp.float32)
+        lh, lw = lat0.shape[2], lat0.shape[3]
+        ctx, pooled = self.encode_text(clip_ids, t5_ids)
+        sigmas = shifted_sigmas(num_steps, shift)
+        start = min(int(num_steps * (1.0 - strength)), num_steps - 1)
+        key = jax.random.PRNGKey(seed)
+        noise = jax.random.normal(key, lat0.shape, jnp.float32)
+        s0 = float(sigmas[start])
+        x = ftx.pack_latents((1.0 - s0) * lat0 + s0 * noise)
+        m = jnp.broadcast_to(jnp.asarray(mask, bool), lat0.shape)
+        mask_packed = ftx.pack_latents(m.astype(jnp.float32)) > 0.5
+        known_packed = ftx.pack_latents(lat0)
+        noise_packed = ftx.pack_latents(noise)
+        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
+        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
+        g = jnp.full((b,), guidance, jnp.float32)
+        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas,
+                     start, known_packed, mask_packed, noise_packed)
+        # final blend: known region restored exactly
+        x = jnp.where(mask_packed, x, known_packed)
+        lat = ftx.unpack_latents(x, lh, lw)
+        out = {"latents": np.asarray(lat), "sigmas": sigmas}
+        if decode:
+            out["images"] = np.asarray(self._vae(self.vae_params, lat))
+        return out
+
+
 def build_random_pipeline(seed: int = 0, tiny: bool = True) -> FluxPipeline:
     """Random-weight pipeline for tests/benches (reference analog: tiny
     random-weight integration configs, SURVEY §4)."""

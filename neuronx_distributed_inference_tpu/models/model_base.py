@@ -124,6 +124,10 @@ class DecoderSpec:
     # None = uniform (sliding_window, if set, applies to every layer).
     layer_pattern: Optional[Tuple[bool, ...]] = None
     local_rope: Optional[RopeConfig] = None   # rope for local layers
+    # rolling sliding-window KV (reference: kv_cache_manager.py:605-606):
+    # the cache holds only ``sliding_window`` slots, written pos %% w with a
+    # position-mapping decode mask — cache bytes scale with w, not seq_len
+    rolling_window: bool = False
     # llama4 attention variations (reference: models/llama4/
     # modeling_llama4_text.py — chunked attention + NoPE layers):
     # local layers use CHUNKED attention (block-diagonal causal over
@@ -596,7 +600,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
                 adapter_ids=None, replace=None, kv_view: int = None,
-                deepstack=None, deepstack_mask=None):
+                deepstack=None, deepstack_mask=None, prefill_lens=None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -739,24 +743,42 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap,
                                     sink=sink)
-        k_full = kv.write_prefill_at_layer(
-            k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
-            li, seq_ids,
-            identity_seq_ids=identity_seq_ids and arange_positions,
-            k_transposed=True)
-        v_full = kv.write_prefill_at_layer(
-            v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
-            li, seq_ids,
-            identity_seq_ids=identity_seq_ids and arange_positions)
+        if spec.rolling_window and prefill_lens is not None:
+            # rolling prefill write: only the LAST w positions of each row
+            # land (earlier ones would alias the same slots and the scatter
+            # order is undefined); padded positions past seq_len are dropped
+            # so they cannot clobber live slots through the modulo
+            w_c = k_full.shape[4]
+            valid = ((positions >= prefill_lens[:, None] - w_c)
+                     & (positions < prefill_lens[:, None]))
+            eff = jnp.where(valid, positions % w_c, k_full.shape[4] + 1)
+            k_full = kv.write_tokens_at_layer(
+                k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
+                li, seq_ids, eff, k_transposed=True)
+            v_full = kv.write_tokens_at_layer(
+                v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
+                li, seq_ids, eff)
+        else:
+            k_full = kv.write_prefill_at_layer(
+                k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
+                li, seq_ids,
+                identity_seq_ids=identity_seq_ids and arange_positions,
+                k_transposed=True)
+            v_full = kv.write_prefill_at_layer(
+                v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
+                li, seq_ids,
+                identity_seq_ids=identity_seq_ids and arange_positions)
     else:
+        roll_w = k_full.shape[4] if spec.rolling_window else 0
         k_full = kv.write_tokens_at_layer(
             k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
-            li, seq_ids, positions, k_transposed=True)
+            li, seq_ids, positions, window=roll_w, k_transposed=True)
         v_full = kv.write_tokens_at_layer(
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
-            li, seq_ids, positions)
+            li, seq_ids, positions, window=roll_w)
         use_kernel = (spec.decode_kernel is not False
                       and decode_attention.supports(spec, hidden.shape[1])
+                      and not spec.rolling_window
                       and identity_seq_ids
                       and hidden.shape[0] == k_full.shape[1]
                       and spec.kv_scale is None and k_full.dtype == dtype
@@ -911,7 +933,7 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                arange_positions: bool = False,
                slot_mapping=None, block_table=None,
                adapter_ids=None, replacements=None, kv_view: int = None,
-               deepstack=None, deepstack_mask=None):
+               deepstack=None, deepstack_mask=None, prefill_lens=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
@@ -933,7 +955,7 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
               arange_positions=arange_positions, slot_mapping=slot_mapping,
               block_table=block_table, adapter_ids=adapter_ids,
               replacements=replacements, kv_view=kv_view,
-              deepstack_mask=deepstack_mask)
+              deepstack_mask=deepstack_mask, prefill_lens=prefill_lens)
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans carrying one contiguous cache
@@ -998,7 +1020,7 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                     identity_seq_ids=False, arange_positions=False,
                     slot_mapping=None, block_table=None, adapter_ids=None,
                     replacements=None, kv_view=None, deepstack=None,
-                    deepstack_mask=None):
+                    deepstack_mask=None, prefill_lens=None):
     """Run one contiguous run of stacked layers against the full cache
     (cache layer index = scan index + ``cache_offset``). Exposed so families
     with interleaved non-standard layers (mllama cross-attention decoder)
@@ -1025,7 +1047,7 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                 adapter_ids,
                 (jax.tree.map(lambda a: a[i], rep)
                  if replacements is not None else None),
-                kv_view=kv_view)
+                kv_view=kv_view, prefill_lens=prefill_lens)
             caps_list.append(caps_i)
         caps = ({k: jnp.stack([c[k] for c in caps_list])
                  for k in caps_list[0]} if caps_list and caps_list[0] else {})
@@ -1043,7 +1065,8 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
             positions, phase, identity_seq_ids, arange_positions,
             slot_mapping, block_table, mlp_kind, adapter_ids,
             rp if replacements is not None else None, kv_view=kv_view,
-            deepstack=ds, deepstack_mask=deepstack_mask)
+            deepstack=ds, deepstack_mask=deepstack_mask,
+            prefill_lens=prefill_lens)
         return (h, k_, v_), caps
 
     xs = (layer_params, is_local, rep, jnp.arange(n, dtype=jnp.int32))
@@ -1131,7 +1154,7 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         identity_seq_ids=not tpu_cfg.is_continuous_batching,
         arange_positions=True, adapter_ids=adapter_ids,
         replacements=replacements, deepstack=deepstack_embeds,
-        deepstack_mask=image_mask)
+        deepstack_mask=image_mask, prefill_lens=seq_lens)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -1168,9 +1191,19 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     so this is a direct throughput win). Writes still address the full cache.
     """
     cache_len = kv_view or kv.cache_len_of(cache)
-    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
-        position_ids, cache_len, window=w, chunk=c),
-        rope_positions=rope_position_ids)
+    if spec.rolling_window:
+        # rolling cache: slot != position; the mask maps slots back to the
+        # positions they hold
+        ai = attn_inputs(
+            spec, position_ids,
+            lambda w, c=0: attn_ops.rolling_decode_mask(position_ids,
+                                                        cache_len),
+            rope_positions=rope_position_ids)
+    else:
+        ai = attn_inputs(spec, position_ids,
+                         lambda w, c=0: attn_ops.decode_mask(
+                             position_ids, cache_len, window=w, chunk=c),
+                         rope_positions=rope_position_ids)
     hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
@@ -1276,6 +1309,37 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     rngs = jax.random.split(rng, num_steps)
     (_, _, _, new_cache), toks = jax.lax.scan(
         step, (first_tokens, position_ids, rope_position_ids, cache), rngs)
+    return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
+
+
+def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                     first_tokens, position_ids, block_table,
+                     sampling_params, rng, num_steps: int):
+    """Fused multi-token PAGED decode: ``num_steps`` steps in one device
+    call with ZERO per-token host work — slot mappings are computed
+    IN-GRAPH from the (pre-extended) block tables, exactly the reference's
+    in-graph tokengen slot-mapping generation
+    (block_kv_cache_manager.py:376-430). The host must pre-allocate blocks
+    covering positions [p, p+num_steps) before the call.
+
+    first_tokens (B,); position_ids (B,); block_table (B, max_blocks).
+    Returns tokens (B, num_steps) + cache."""
+    bs = cache["k"].shape[2]                  # paged (L, N, Bs, H, D)
+    b = first_tokens.shape[0]
+    rows = jnp.arange(b)
+
+    def step(carry, step_rng):
+        tok, pos, cch = carry
+        slot = (block_table[rows, pos // bs] * bs + pos % bs)
+        out = paged_forward_step(
+            spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
+            pos[:, None], slot[:, None], block_table,
+            jnp.zeros((b,), jnp.int32), sampling_params, step_rng)
+        return (out["tokens"], pos + 1, out["cache"]), out["tokens"]
+
+    rngs = jax.random.split(rng, num_steps)
+    (_, _, new_cache), toks = jax.lax.scan(
+        step, (first_tokens, position_ids, cache), rngs)
     return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
 
 
@@ -1388,6 +1452,25 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)
+    if "rolling_window" not in kw:
+        roll = tcfg.rolling_kv_cache
+        sc = tcfg.speculation_config
+        has_spec = bool(sc and (sc.speculation_length
+                                or sc.medusa_speculation_length))
+        eligible = (kw.get("sliding_window", 0) > 0
+                    and kw.get("layer_pattern") is None
+                    and kw.get("attn_chunk", 0) == 0
+                    and tcfg.seq_len > kw.get("sliding_window", 0)
+                    and not tcfg.is_block_kv_layout
+                    and not tcfg.flash_decoding_enabled
+                    and not has_spec)
+        if roll is None:
+            roll = eligible
+        elif roll and not eligible:
+            raise ValueError(
+                "rolling_kv_cache requires a uniform sliding_window model "
+                "without speculation/paged-KV/flash-decoding")
+        kw["rolling_window"] = bool(roll)
     if not kw.get("vocab_parallel", True) and tp > 1:
         # older saved configs carry vocab_parallel=false from when the knob
         # was inert; honoring it replicates the (V, H) table on every device

@@ -54,3 +54,4 @@ def get_target_bucket(buckets: List[int], length: int) -> int:
         if b >= length:
             return b
     raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
+

@@ -21,11 +21,10 @@ Supported behaviors mirrored from the reference:
   * CTE write  = batch-row scatter at seq_ids (continuous batching single-seq
     update, kv_cache_manager.py:483-497)
   * TKG write  = scatter at (seq_ids, position_ids) (:431-586)
-  * sliding-window rolling write pos % window (:605-606) — NOTE: rolling
-    cache is not yet wired into the model base (sliding-window families
-    currently use a full-length cache + window mask, which is correct but
-    not memory-minimal; decode_mask assumes slot i holds position i, so
-    wiring the rolling layout needs a position-mapping mask too)
+  * sliding-window rolling write pos % window (:605-606) — wired through
+    the model base for uniform-window models (spec.rolling_window): the
+    cache holds w slots, decode uses attention.rolling_decode_mask (the
+    position-mapping mask), prefill writes only each row's last w positions
   * per-layer cache sizes for mixed local/global attention (gpt-oss manager)
   * fp8 KV quantization, direct-cast mode (:636-692)
 """

@@ -150,6 +150,19 @@ def prefill_causal_mask(seq_len: int, position_ids: jnp.ndarray,
     return causal_mask(position_ids, position_ids, None, window, chunk)
 
 
+def rolling_decode_mask(position_ids: jnp.ndarray, window: int
+                        ) -> jnp.ndarray:
+    """Decode mask over a ROLLING cache of ``window`` slots where slot j
+    holds position p_j = P - ((P - j) mod w) for current position P —
+    attend iff that position exists (p_j >= 0); the window constraint
+    p_j > P - w is inherent to the layout (reference rolling write:
+    kv_cache_manager.py:605-606)."""
+    qp = position_ids[:, :, None]                    # (B, T, 1)
+    j = jnp.arange(window, dtype=position_ids.dtype)[None, None, :]
+    pj = qp - ((qp - j) % window)
+    return pj >= 0
+
+
 def decode_mask(position_ids: jnp.ndarray, cache_len: int,
                 window: int = 0, chunk: int = 0) -> jnp.ndarray:
     """Mask for token generation over a contiguous cache of length

@@ -127,3 +127,27 @@ def test_cli_run_token_matching(hf_dir, capsys):
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "PASS" in out
+
+
+def test_batch_repad_and_subbatch(hf_dir):
+    """Serving host shim (reference: model_wrapper.py:520-703 pad +
+    :1315-1440 sub-batching): a 1-row request pads by repeating row 0; a
+    5-row request splits into compiled-batch chunks; outputs match the
+    exact-batch run row for row."""
+    app = _app(hf_dir)
+    rng = np.random.default_rng(4)
+    ids = rng.integers(1, 500, size=(5, 10)).astype(np.int32)
+    # exact-batch references, computed two rows at a time
+    refs = []
+    for lo in range(0, 4, 2):
+        app.reset()
+        refs.append(app.generate(ids[lo:lo + 2], max_new_tokens=6)["generated"])
+    app.reset()
+    one = app.generate(ids[:1], max_new_tokens=6)     # pad 1 -> 2
+    np.testing.assert_array_equal(one["generated"], refs[0][:1])
+    assert one["generated"].shape[0] == 1
+    app.reset()
+    five = app.generate(ids, max_new_tokens=6)        # sub-batch 5 -> 2+2+1
+    np.testing.assert_array_equal(five["generated"][:2], refs[0])
+    np.testing.assert_array_equal(five["generated"][2:4], refs[1])
+    assert five["generated"].shape[0] == 5

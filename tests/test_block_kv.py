@@ -187,3 +187,25 @@ def test_chunked_intra_batch_prefix_sharing(cfg_pair):
     got = app_k.generate(ids, max_new_tokens=4)
     np.testing.assert_array_equal(got["generated"], want["generated"])
     app_k.release()
+
+
+def test_paged_chunked_decode_matches_single_step(cfg_pair):
+    """Fetch-free paged decode (model_base.paged_decode_loop): chunked
+    decode with IN-GRAPH slot mapping must equal the per-step path
+    (reference: in-graph tokengen slot mapping,
+    block_kv_cache_manager.py:376-430)."""
+    _, paged_cfg = cfg_pair
+    ids = np.random.default_rng(3).integers(1, 512, size=(2, 9),
+                                            dtype=np.int64)
+    app1 = PagedCausalLMApplication(None, paged_cfg, LlamaFamily)
+    app1.init_random_weights(7).init_cache()
+    ref = app1.generate(ids, max_new_tokens=9)
+
+    import copy
+    cfg4 = copy.deepcopy(paged_cfg)
+    cfg4.tpu_config.decode_chunk_tokens = 4
+    app4 = PagedCausalLMApplication(None, cfg4, LlamaFamily)
+    app4.init_random_weights(7).init_cache()
+    got = app4.generate(ids, max_new_tokens=9)
+    np.testing.assert_array_equal(got["sequences"], ref["sequences"])
+    assert ("paged_loop", 4) in app4._compiled

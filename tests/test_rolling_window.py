@@ -1,0 +1,92 @@
+"""Rolling sliding-window KV cache (reference: kv_cache_manager.py:605-606
+rolling write + sliding_window module): the cache holds only ``w`` slots —
+bytes scale with the window, not seq_len — with a position-mapping decode
+mask. Gate: rolling output must equal the full-cache windowed-mask path and
+the HF golden."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import (TpuConfig,
+                                                      load_pretrained_config)
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.family import get_family
+from neuronx_distributed_inference_tpu.utils.testing import \
+    check_generation_golden
+
+
+@pytest.fixture(scope="module")
+def mistral_dir(tmp_path_factory):
+    from transformers import MistralConfig, MistralForCausalLM
+    torch.manual_seed(0)
+    cfg = MistralConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        sliding_window=8, max_position_embeddings=128, rms_norm_eps=1e-5,
+        attention_dropout=0.0, torch_dtype="float32")
+    m = MistralForCausalLM(cfg)
+    m.eval()
+    m.generation_config.eos_token_id = None
+    d = tmp_path_factory.mktemp("mistral_roll")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, str(d)
+
+
+def _app(d, rolling):
+    fam = get_family("mistral")
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     output_logits=True, enable_bucketing=False,
+                     rolling_kv_cache=rolling)
+    icfg = fam.config_cls(tcfg, load_config=load_pretrained_config(d))
+    app = CausalLMApplication(d, icfg, fam)
+    app.load_weights().init_cache()
+    return app
+
+
+def test_rolling_cache_matches_full_and_hf(mistral_dir):
+    hf, d = mistral_dir
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 250, size=(2, 12)).astype(np.int64)
+
+    app_full = _app(d, rolling=False)
+    assert not app_full.spec.rolling_window
+    full = app_full.generate(ids.astype(np.int32), max_new_tokens=24)
+
+    app_roll = _app(d, rolling=None)          # auto: on (uniform window)
+    assert app_roll.spec.rolling_window
+    # cache bytes scale with w: S dim is the window, not seq_len
+    assert app_roll.cache["v"].shape[3] == 8
+    assert app_roll.cache["k"].shape[4] == 8
+    roll = app_roll.generate(ids.astype(np.int32), max_new_tokens=24)
+    np.testing.assert_array_equal(roll["sequences"], full["sequences"])
+
+    # decode well past the window still matches HF (golden gate; 12 + 24
+    # tokens crosses the 8-token window nearly 4x over)
+    app_roll.reset()
+    check_generation_golden(app_roll, ids, hf, max_new_tokens=20, atol=6e-3)
+
+
+def test_rolling_prefill_longer_than_window(mistral_dir):
+    """Prompts longer than w: only the last w positions land; generation
+    still matches the full-cache path."""
+    _, d = mistral_dir
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 20)).astype(np.int32)  # 20 > w=8
+    full = _app(d, rolling=False).generate(ids, max_new_tokens=10)
+    roll = _app(d, rolling=True).generate(ids, max_new_tokens=10)
+    np.testing.assert_array_equal(roll["sequences"], full["sequences"])
+
+
+def test_rolling_rejected_for_speculation(mistral_dir):
+    from neuronx_distributed_inference_tpu.config import SpeculationConfig
+    _, d = mistral_dir
+    fam = get_family("mistral")
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     rolling_kv_cache=True,
+                     speculation_config=SpeculationConfig(
+                         speculation_length=3))
+    with pytest.raises(ValueError, match="rolling_kv_cache"):
+        fam.build_spec(fam.config_cls(
+            tcfg, load_config=load_pretrained_config(d)))
