@@ -419,44 +419,67 @@ class CausalLMApplication:
         order is free."""
         b_in = input_ids.shape[0]
         cfg = self.tpu_config
+        # explicit per-row kwargs — a shape heuristic would misclassify e.g.
+        # a multi-valued eos_token_id list whose length happens to equal b
+        per_row = ("attention_mask", "sampling_params", "teacher_tokens",
+                   "adapter_ids", "image_mask", "rope_position_ids",
+                   "decode_rope_start", "image_embeds")
 
-        def _batchful(x):
-            if x is None:
-                return False
-            a = np.asarray(x) if not hasattr(x, "shape") else x
-            return getattr(a, "ndim", 0) >= 1 and a.shape[0] == b_in
+        def _batchful(k, x):
+            return k in per_row and x is not None
 
         if b_in > cfg.batch_size:
             # sub-batching: compiled-batch chunks (last padded recursively)
             outs = []
             for lo in range(0, b_in, cfg.batch_size):
                 hi = min(lo + cfg.batch_size, b_in)
-                sub = {k: (np.asarray(v)[lo:hi] if _batchful(v) else v)
+                sub = {k: (np.asarray(v)[lo:hi] if _batchful(k, v) else v)
                        for k, v in kw.items()}
                 # deepstack stacks batch on axis 1
                 if kw.get("deepstack_embeds") is not None:
-                    sub["deepstack_embeds"] =                         np.asarray(kw["deepstack_embeds"])[:, lo:hi]
+                    sub["deepstack_embeds"] = \
+                        np.asarray(kw["deepstack_embeds"])[:, lo:hi]
                 outs.append(self.generate(input_ids[lo:hi], **sub))
-            merged = {
-                "sequences": np.concatenate([o["sequences"] for o in outs]),
-                "generated": np.concatenate([o["generated"] for o in outs]),
-            }
+
+            def _cat(key):
+                # chunks may stop at different EOS points: right-pad each
+                # chunk to the widest before concatenating (0 = the
+                # post-EOS fill convention)
+                arrs = [np.asarray(o[key]) for o in outs]
+                w = max(a.shape[1] for a in arrs)
+                return np.concatenate(
+                    [np.pad(a, ((0, 0), (0, w - a.shape[1])))
+                     for a in arrs])
+
+            merged = {"sequences": _cat("sequences"),
+                      "generated": _cat("generated")}
+            if "seq_lens" in outs[0]:
+                merged["seq_lens"] = np.concatenate(
+                    [np.asarray(o["seq_lens"]) for o in outs])
             for extra in ("ttft_s",):
                 if extra in outs[0]:
                     merged[extra] = outs[0][extra]
             if kw.get("return_logits") and "logits" in outs[0]:
-                merged["logits"] = [o["logits"] for o in outs]
+                # keep the per-step list contract: step i concatenates all
+                # chunks' step-i logits; chunks that stopped early repeat
+                # their final step
+                n_steps = max(len(o["logits"]) for o in outs)
+                merged["logits"] = [
+                    np.concatenate([np.asarray(
+                        o["logits"][min(si, len(o["logits"]) - 1)])
+                        for o in outs], axis=0)
+                    for si in range(n_steps)]
             return merged
 
         pad = cfg.batch_size - b_in
 
-        def _pad0(x):
-            if not _batchful(x):
+        def _pad0(k, x):
+            if not _batchful(k, x):
                 return x
             a = np.asarray(x)
             return np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
 
-        kw2 = {k: _pad0(v) for k, v in kw.items()}
+        kw2 = {k: _pad0(k, v) for k, v in kw.items()}
         if kw.get("deepstack_embeds") is not None:
             ds = np.asarray(kw["deepstack_embeds"])
             kw2["deepstack_embeds"] = np.concatenate(

@@ -73,11 +73,7 @@ class FluxPipeline:
         g = jnp.full((b,), guidance, jnp.float32)
 
         sigmas = shifted_sigmas(num_steps, shift)
-        for i in range(num_steps):
-            t = jnp.full((b,), sigmas[i], jnp.float32)
-            v = self._flux(self.params, x, ctx, t, pooled, img_ids, txt_ids,
-                           guidance=g)
-            x = euler_step(x, v, float(sigmas[i]), float(sigmas[i + 1]))
+        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas, 0)
 
         lat = ftx.unpack_latents(x, lh, lw)
         out = {"latents": np.asarray(lat), "sigmas": sigmas}

@@ -1457,19 +1457,26 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         sc = tcfg.speculation_config
         has_spec = bool(sc and (sc.speculation_length
                                 or sc.medusa_speculation_length))
-        eligible = (kw.get("sliding_window", 0) > 0
-                    and kw.get("layer_pattern") is None
-                    and kw.get("attn_chunk", 0) == 0
-                    and tcfg.seq_len > kw.get("sliding_window", 0)
-                    and not tcfg.is_block_kv_layout
-                    and not tcfg.flash_decoding_enabled
-                    and not has_spec)
+        blockers = []
+        if not (kw.get("sliding_window", 0) > 0
+                and kw.get("layer_pattern") is None
+                and kw.get("attn_chunk", 0) == 0):
+            blockers.append("needs a uniform sliding_window model")
+        if tcfg.is_block_kv_layout:
+            blockers.append("incompatible with the paged KV layout")
+        if tcfg.flash_decoding_enabled:
+            blockers.append("incompatible with flash decoding")
+        if has_spec:
+            blockers.append("incompatible with speculation")
+        # a window >= seq_len simply never rolls; allow but skip (the full
+        # cache is already window-sized)
+        worth_it = tcfg.seq_len > kw.get("sliding_window", 0)
         if roll is None:
-            roll = eligible
-        elif roll and not eligible:
-            raise ValueError(
-                "rolling_kv_cache requires a uniform sliding_window model "
-                "without speculation/paged-KV/flash-decoding")
+            roll = not blockers and worth_it
+        elif roll and blockers:
+            raise ValueError("rolling_kv_cache: " + "; ".join(blockers))
+        elif roll and not worth_it:
+            roll = False
         kw["rolling_window"] = bool(roll)
     if not kw.get("vocab_parallel", True) and tp > 1:
         # older saved configs carry vocab_parallel=false from when the knob

@@ -258,16 +258,34 @@ def convert_hf_state_dict(sd: Dict[str, np.ndarray], spec: WhisperSpec
     }
 
 
+def whisper_pspec(name: str, ndim: int):
+    """TP sharding by weight-name suffix (Column/RowParallelLinear analog
+    for the q/k/v/fc1 vs o/fc2 projections; norms/embeddings replicated).
+    Works for both bare and layer-stacked (leading L dim) leaves."""
+    from jax.sharding import PartitionSpec as P
+    from ...parallel.mesh import AXIS_MP
+    if name.endswith(("_q_w", "_k_w", "_v_w", "fc1_w")):
+        return P(*([None] * (ndim - 1) + [AXIS_MP]))       # (…, in, OUT)
+    if name.endswith(("_o_w", "fc2_w")):
+        return P(*([None] * (ndim - 2) + [AXIS_MP, None]))  # (…, IN, out)
+    if name.endswith(("_q_b", "_v_b", "fc1_b")):
+        return P(*([None] * (ndim - 1) + [AXIS_MP]))
+    return P()
+
+
 class WhisperApplication:
     """Encode-once + autoregressive decode (reference: the whisper encoder/
     decoder NeuronApplications with their own prefill/decode ModelWrappers).
-    """
+    Weights shard tensor-parallel over the mesh's model-parallel axes."""
 
-    def __init__(self, model_path: Optional[str], config: InferenceConfig):
+    def __init__(self, model_path: Optional[str], config: InferenceConfig,
+                 mesh=None):
+        from ...parallel.mesh import mesh_from_config
         self.config = config
         self.tpu_config = config.tpu_config
         self.spec = spec_from_hf_config(config)
         self.model_path = model_path
+        self.mesh = mesh or mesh_from_config(config.tpu_config)
         self.params = None
         self._encode = jax.jit(partial(encoder_forward, self.spec))
         self._cross = jax.jit(partial(compute_cross_kv, self.spec))
@@ -276,9 +294,17 @@ class WhisperApplication:
 
     def load_weights(self, model_path: Optional[str] = None):
         from ...utils import checkpoint as ckpt
+        from jax.sharding import NamedSharding
         sd = ckpt.load_state_dict(model_path or self.model_path)
         host = convert_hf_state_dict(sd, self.spec)
-        self.params = jax.tree.map(jnp.asarray, host)
+        flat, tree = jax.tree_util.tree_flatten_with_path(host)
+        leaves = []
+        for path, arr in flat:
+            name = str(path[-1].key)
+            sh = NamedSharding(self.mesh,
+                               whisper_pspec(name, np.asarray(arr).ndim))
+            leaves.append(jax.device_put(jnp.asarray(arr), sh))
+        self.params = jax.tree_util.tree_unflatten(tree, leaves)
         return self
 
     def init_cache(self, batch: int):
@@ -292,8 +318,9 @@ class WhisperApplication:
                  ) -> Dict[str, Any]:
         """Greedy transcription. input_features (B, n_mels, T)."""
         b = input_features.shape[0]
-        enc = self._encode(self.params, jnp.asarray(input_features))
-        cross = self._cross(self.params, enc)
+        with jax.sharding.set_mesh(self.mesh):
+            enc = self._encode(self.params, jnp.asarray(input_features))
+            cross = self._cross(self.params, enc)
         cache = self.init_cache(b)
         if decoder_input_ids is None:
             decoder_input_ids = np.full((b, 1), self.spec.decoder_start_token_id,
@@ -301,7 +328,8 @@ class WhisperApplication:
         toks = np.asarray(decoder_input_ids, np.int32)
         t0 = toks.shape[1]
         pos = np.broadcast_to(np.arange(t0, dtype=np.int32), (b, t0))
-        out = self._step(self.params, cache, cross, jnp.asarray(toks),
+        with jax.sharding.set_mesh(self.mesh):
+                out = self._step(self.params, cache, cross, jnp.asarray(toks),
                          jnp.asarray(pos))
         cache = out["cache"]
         cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),
@@ -310,7 +338,8 @@ class WhisperApplication:
         done = cur == self.spec.eos_token_id
         for i in range(1, max_new_tokens):
             p = np.full((b, 1), t0 + i - 1, np.int32)
-            out = self._step(self.params, cache, cross,
+            with jax.sharding.set_mesh(self.mesh):
+                out = self._step(self.params, cache, cross,
                              jnp.asarray(generated[-1][:, -1:]), jnp.asarray(p))
             cache = out["cache"]
             cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),

@@ -151,3 +151,27 @@ def test_batch_repad_and_subbatch(hf_dir):
     np.testing.assert_array_equal(five["generated"][:2], refs[0])
     np.testing.assert_array_equal(five["generated"][2:4], refs[1])
     assert five["generated"].shape[0] == 5
+
+
+def test_subbatch_ragged_eos_and_logits(hf_dir):
+    """Sub-batches stopping at different EOS points must merge (right-pad
+    to the widest) and logits must keep the per-step list contract."""
+    app = _app(hf_dir)
+    rng = np.random.default_rng(6)
+    ids = rng.integers(1, 500, size=(4, 8)).astype(np.int32)
+    app.reset()
+    ref = app.generate(ids[:2], max_new_tokens=6, return_logits=True)
+    # force chunk 0 to stop immediately: its rows' first generated token
+    eos = [int(ref["generated"][0, 0]), int(ref["generated"][1, 0])]
+    app.reset()
+    out = app.generate(ids, max_new_tokens=6, eos_token_id=eos,
+                       return_logits=True)
+    assert out["generated"].shape[0] == 4
+    # per-step list of (4, ...) arrays, not a list of per-chunk lists
+    assert isinstance(out["logits"][0], np.ndarray)
+    assert all(np.asarray(lg).shape[0] == 4 for lg in out["logits"])
+    # eos_token_id with len == batch must NOT be sliced per chunk
+    app.reset()
+    out2 = app.generate(ids, max_new_tokens=6,
+                        eos_token_id=[eos[0], eos[1], 1, 2])
+    assert out2["generated"].shape[0] == 4
