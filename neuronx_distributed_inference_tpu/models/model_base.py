@@ -24,6 +24,8 @@ from dataclasses import dataclass, field, replace
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -600,7 +602,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 slot_mapping=None, block_table=None,
                 mlp_kind: Optional[str] = None,
                 adapter_ids=None, replace=None, kv_view: int = None,
-                deepstack=None, deepstack_mask=None, prefill_lens=None):
+                deepstack=None, deepstack_mask=None, prefill_lens=None,
+                side=None, chunk_idx=None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -629,6 +632,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     if mlp_kind is None:
         mlp_kind = "dense" if spec.moe is None else "moe"
     caps: Dict[str, Any] = {}
+    pending = None
 
     def _tap(name, val):
         """Tensor replacement (golden injection) then capture at one point
@@ -769,14 +773,24 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 li, seq_ids,
                 identity_seq_ids=identity_seq_ids and arange_positions)
     else:
-        roll_w = k_full.shape[4] if spec.rolling_window else 0
-        k_full = kv.write_tokens_at_layer(
-            k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
-            li, seq_ids, positions, window=roll_w, k_transposed=True)
-        v_full = kv.write_tokens_at_layer(
-            v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
-            li, seq_ids, positions, window=roll_w)
-        use_kernel = (spec.decode_kernel is not False
+        pending = None
+        if side is not None:
+            # chunked decode (ops/attention.mha_decode_merged): the step's
+            # K/V are handed back as PENDING — run_layer_slice batches all
+            # layers' side-buffer writes into one update pair per step; the
+            # BIG cache is read-only inside the decode scan and committed
+            # once per chunk
+            pending = (k, v)
+        else:
+            roll_w = k_full.shape[4] if spec.rolling_window else 0
+            k_full = kv.write_tokens_at_layer(
+                k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
+                li, seq_ids, positions, window=roll_w, k_transposed=True)
+            v_full = kv.write_tokens_at_layer(
+                v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
+                li, seq_ids, positions, window=roll_w)
+        use_kernel = (side is None
+                      and spec.decode_kernel is not False
                       and decode_attention.supports(spec, hidden.shape[1])
                       and not spec.rolling_window
                       and identity_seq_ids
@@ -818,13 +832,29 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             # D) — each attention einsum contracts its operand in place
             # (any shared layout costs a materialized relayout of the live
             # cache per layer per step)
-            k_layer = kv.read_layer_hl(k_full, li)       # (B, H, D, S)
-            v_layer = kv.read_layer_hl(v_full, li)       # (B, H, S, D)
-            if kv_view is not None and kv_view < v_layer.shape[2]:
-                # decode seq bucket: read only the live prefix (the mask is
-                # built against the same kv_view length)
-                k_layer = k_layer[:, :, :, :kv_view]
-                v_layer = v_layer[:, :, :kv_view]
+            view = kv_view if (kv_view is not None
+                               and kv_view < v_full.shape[3]) else None
+            if isinstance(li, int) and view is not None:
+                # decode unrolls layers with static indices: fold the layer
+                # AND seq-bucket slice into ONE static slice so XLA stages
+                # only the live prefix (two chained slices staged the full
+                # row first — measured 2x the staging bytes)
+                lb, hb, db = (k_full.shape[1], k_full.shape[2],
+                              k_full.shape[3])
+                k_layer = jax.lax.slice(
+                    k_full, (li, 0, 0, 0, 0),
+                    (li + 1, lb, hb, db, view))[0]       # (B, H, D, view)
+                v_layer = jax.lax.slice(
+                    v_full, (li, 0, 0, 0, 0),
+                    (li + 1, lb, hb, view, v_full.shape[4]))[0]
+            else:
+                k_layer = kv.read_layer_hl(k_full, li)   # (B, H, D, S)
+                v_layer = kv.read_layer_hl(v_full, li)   # (B, H, S, D)
+                if view is not None:
+                    # decode seq bucket: read only the live prefix (the mask
+                    # is built against the same kv_view length)
+                    k_layer = k_layer[:, :, :, :view]
+                    v_layer = v_layer[:, :, :view]
             if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
                 # static guarantee that seq_ids == arange (no continuous
                 # batching): skip the row-gather copy of the whole cache
@@ -837,9 +867,19 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 v_all = kv.dequantize_kv(
                     kv.gather_cache_rows(v_layer, seq_ids), dtype,
                     spec.kv_scale)
-            attn_out = attn_ops.mha_hl(q, k_all, v_all, mask, spec.scale,
-                                       logits_soft_cap=spec.attn_soft_cap,
-                                       sink=sink)
+            if side is not None:
+                # 'mask' here is the PRIOR mask (chunk slots excluded by the
+                # chunk loop); earlier chunk tokens enter through the side
+                # buffer with their own mask, the active token in-register
+                mask_side = ai["mask_side"]
+                attn_out = attn_ops.mha_decode_merged(
+                    q, k_all, v_all, mask, side[0][li], side[1][li],
+                    mask_side, k.astype(dtype), v.astype(dtype), spec.scale,
+                    logits_soft_cap=spec.attn_soft_cap, sink=sink)
+            else:
+                attn_out = attn_ops.mha_hl(q, k_all, v_all, mask, spec.scale,
+                                           logits_soft_cap=spec.attn_soft_cap,
+                                           sink=sink)
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
@@ -897,6 +937,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             h + m, AXIS_DP, sp_axis, None)
         hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
         hidden = _tap("layer_output", hidden)
+        if side is not None:
+            return hidden, k_full, v_full, caps, pending
         return hidden, k_full, v_full, caps
 
     hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
@@ -911,6 +953,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
     hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
     hidden = _tap("layer_output", hidden)
+    if side is not None:
+        return hidden, k_full, v_full, caps, pending
     return hidden, k_full, v_full, caps
 
 
@@ -933,14 +977,18 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
                arange_positions: bool = False,
                slot_mapping=None, block_table=None,
                adapter_ids=None, replacements=None, kv_view: int = None,
-               deepstack=None, deepstack_mask=None, prefill_lens=None):
+               deepstack=None, deepstack_mask=None, prefill_lens=None,
+               side=None, chunk_idx=None):
     """lax.scan over the stacked layer weights.
 
     Replaces the reference's per-layer Python loop
     (models/model_base.py:1216-1469 get_model_output).
     ai: attn_inputs() bundle; replacements: {point: (L,B,T,H),
     point+"_on": (L,)} golden-injection arrays.
-    Returns (hidden, new_cache, captured) — captured = {} unless
+    side: chunked-decode side buffers (see ``decode_loop``) — when set the
+    big cache is read-only and the return gains a 4th element, the updated
+    side pair.
+    Returns (hidden, new_cache, captured[, side]) — captured = {} unless
     spec.capture names per-layer points (then each is stacked (L, ...)).
     """
     is_local = jnp.asarray(spec.layer_pattern if spec.layer_pattern is not None
@@ -955,22 +1003,33 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
               arange_positions=arange_positions, slot_mapping=slot_mapping,
               block_table=block_table, adapter_ids=adapter_ids,
               replacements=replacements, kv_view=kv_view,
-              deepstack_mask=deepstack_mask, prefill_lens=prefill_lens)
+              deepstack_mask=deepstack_mask, prefill_lens=prefill_lens,
+              chunk_idx=chunk_idx)
+
+    def unpack(res, side_now):
+        if side_now is not None:
+            return res
+        return res + (None,)
+
     if spec.moe is not None and spec.first_dense > 0:
         # mixed stacks (deepseek first_k_dense_replace): dense layers then
         # MoE layers, two scans carrying one contiguous cache
         nd = spec.first_dense
         L = spec.num_layers
         ds = deepstack
-        hidden, kf, vf, c1 = run_layer_slice(
+        hidden, kf, vf, c1, side = unpack(run_layer_slice(
             spec, params["layers"], cache["k"], cache["v"], hidden, ai,
             cache_offset=0, is_local=is_local[:nd], rep=sl(0, nd),
-            mlp_kind="dense", deepstack=None if ds is None else ds[:nd], **kw)
-        hidden, kf, vf, c2 = run_layer_slice(
+            mlp_kind="dense", deepstack=None if ds is None else ds[:nd],
+            side=side, **kw), side)
+        hidden, kf, vf, c2, side = unpack(run_layer_slice(
             spec, params["moe_layers"], kf, vf, hidden, ai,
             cache_offset=nd, is_local=is_local[nd:], rep=sl(nd, L),
-            mlp_kind="moe", deepstack=None if ds is None else ds[nd:], **kw)
+            mlp_kind="moe", deepstack=None if ds is None else ds[nd:],
+            side=side, **kw), side)
         caps = {k: jnp.concatenate([c1[k], c2[k]]) for k in c1}
+        if side is not None:
+            return hidden, {"k": kf, "v": vf}, caps, side
         return hidden, {"k": kf, "v": vf}, caps
 
     if spec.moe is not None and spec.moe_pattern is not None:
@@ -994,23 +1053,28 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
             j0 = stack_pos[kind]
             stack_pos[kind] += count
             seg = jax.tree.map(lambda a: a[j0:j0 + count], stack)
-            hidden, kf, vf, c = run_layer_slice(
+            hidden, kf, vf, c, side = unpack(run_layer_slice(
                 spec, seg, kf, vf, hidden, ai, cache_offset=start,
                 is_local=is_local[start:start + count],
                 rep=sl(start, start + count), mlp_kind=kind,
                 deepstack=(None if deepstack is None
-                           else deepstack[start:start + count]), **kw)
+                           else deepstack[start:start + count]),
+                side=side, **kw), side)
             caps_parts.append(c)
         caps = ({k: jnp.concatenate([c[k] for c in caps_parts])
                  for k in caps_parts[0]} if caps_parts and caps_parts[0]
                 else {})
+        if side is not None:
+            return hidden, {"k": kf, "v": vf}, caps, side
         return hidden, {"k": kf, "v": vf}, caps
 
     L = spec.num_layers
-    hidden, kf, vf, caps = run_layer_slice(
+    hidden, kf, vf, caps, side = unpack(run_layer_slice(
         spec, params["layers"], cache["k"], cache["v"], hidden, ai,
         cache_offset=0, is_local=is_local, rep=rep, mlp_kind=None,
-        deepstack=deepstack, **kw)
+        deepstack=deepstack, side=side, **kw), side)
+    if side is not None:
+        return hidden, {"k": kf, "v": vf}, caps, side
     return hidden, {"k": kf, "v": vf}, caps
 
 
@@ -1020,7 +1084,8 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                     identity_seq_ids=False, arange_positions=False,
                     slot_mapping=None, block_table=None, adapter_ids=None,
                     replacements=None, kv_view=None, deepstack=None,
-                    deepstack_mask=None, prefill_lens=None):
+                    deepstack_mask=None, prefill_lens=None,
+                    side=None, chunk_idx=None):
     """Run one contiguous run of stacked layers against the full cache
     (cache layer index = scan index + ``cache_offset``). Exposed so families
     with interleaved non-standard layers (mllama cross-attention decoder)
@@ -1038,19 +1103,40 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
 
     if phase == "decode" and jax.tree.leaves(hidden)[0].shape[1] == 1:
         caps_list = []
+        pend = []
         for i in range(n):
             layer_w = jax.tree.map(lambda a: a[i], layer_params)
-            hidden, kf, vf, caps_i = _layer_body(
+            res = _layer_body(
                 spec, hidden, layer_w, kf, vf, i + cache_offset, ai,
                 is_local[i], seq_ids, positions, phase, identity_seq_ids,
                 arange_positions, slot_mapping, block_table, mlp_kind,
                 adapter_ids,
                 (jax.tree.map(lambda a: a[i], rep)
                  if replacements is not None else None),
-                kv_view=kv_view, prefill_lens=prefill_lens)
+                kv_view=kv_view, prefill_lens=prefill_lens,
+                side=side, chunk_idx=chunk_idx)
+            if side is not None:
+                hidden, kf, vf, caps_i, pending = res
+                pend.append(pending)
+            else:
+                hidden, kf, vf, caps_i = res
             caps_list.append(caps_i)
         caps = ({k: jnp.stack([c[k] for c in caps_list])
                  for k in caps_list[0]} if caps_list and caps_list[0] else {})
+        if side is not None:
+            # ONE side-buffer update pair per step for the whole layer run
+            # (32 per-layer updates force a write-friendly layout onto the
+            # scan-carried side buffers and relayout the reads)
+            sk, sv = side
+            k_stack = jnp.stack([p[0][:, 0] for p in pend])   # (n, B, H, D)
+            v_stack = jnp.stack([p[1][:, 0] for p in pend])
+            sk = jax.lax.dynamic_update_slice(
+                sk, k_stack[..., None].astype(sk.dtype),
+                (cache_offset, 0, 0, 0, chunk_idx))
+            sv = jax.lax.dynamic_update_slice(
+                sv, v_stack[:, :, :, None, :].astype(sv.dtype),
+                (cache_offset, 0, 0, chunk_idx, 0))
+            return hidden, kf, vf, caps, (sk, sv)
         return hidden, kf, vf, caps
 
     def body(carry, xs):
@@ -1289,6 +1375,89 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     """
 
     use_mrope = rope_position_ids is not None
+    b = first_tokens.shape[0]
+
+    # Chunked side-buffer decode (hot path): the big cache is READ-ONLY
+    # inside the scan — the chunk's K/V accumulate in a small per-chunk side
+    # buffer and land in the cache with ONE bulk write per chunk. Any write
+    # into the scan-carried cache makes XLA pick a write-friendly layout for
+    # the carry and relayout-copy the live cache for the attention reads
+    # every step (~0.29 ms/step at B=2/S=1024/16L on v5e). Geometries the
+    # Pallas decode kernel is admitted for (window/sink/local patterns,
+    # models/model_base.py kernel admission) keep the per-step path.
+    chunkable = (num_steps > 1
+                 and not tpu_cfg.is_continuous_batching
+                 and b == cache["k"].shape[1]
+                 and not spec.rolling_window
+                 and not spec.flash_decoding
+                 and spec.decode_kernel is not True
+                 and not (spec.attn_sink or spec.sliding_window > 0
+                          or spec.layer_pattern is not None
+                          or spec.attn_chunk > 0))
+    if rope_position_ids is None:
+        rope_position_ids = jnp.zeros((b, 3), position_ids.dtype)
+    rngs = jax.random.split(rng, num_steps)
+
+    if chunkable:
+        C = num_steps
+        g = spec.gqa
+        side_k0 = jnp.zeros((spec.num_layers, b, g.num_kv_heads,
+                             spec.head_dim, C), spec.dtype)
+        side_v0 = jnp.zeros((spec.num_layers, b, g.num_kv_heads, C,
+                             spec.v_head_dim), spec.dtype)
+        start_pos = position_ids                       # (B,)
+        cache_len = kv_view or kv.cache_len_of(cache)
+        slots = jnp.arange(cache_len)[None, None, :]
+        side_positions = (start_pos[:, None]
+                          + jnp.arange(C, dtype=position_ids.dtype)[None, :])
+
+        def step(carry, xs):
+            tok, pos, rpos, sk, sv = carry
+            step_rng, idx = xs
+            pos2 = pos[:, None]
+
+            def prior_mask(w, c=0):
+                m = attn_ops.decode_mask(pos2, cache_len, window=w, chunk=c)
+                return jnp.logical_and(
+                    m, slots < start_pos[:, None, None])
+
+            ai = attn_inputs(
+                spec, pos2, prior_mask,
+                rope_positions=rpos[:, None, :] if use_mrope else None)
+            # the active token (slot idx) is folded in-register, not read
+            # from the side buffer — its side write lands at step end
+            ai["mask_side"] = jnp.logical_and(
+                attn_ops.causal_mask(pos2, side_positions, None,
+                                     spec.sliding_window, spec.attn_chunk),
+                jnp.arange(C, dtype=jnp.int32)[None, None, :] != idx)
+            hidden = _embed(spec, params, tok[:, None], pos2)
+            hidden, _, _, (sk, sv) = run_layers(
+                spec, params, cache, hidden, ai, seq_ids, pos2, "decode",
+                identity_seq_ids=True, adapter_ids=adapter_ids,
+                kv_view=kv_view, side=(sk, sv), chunk_idx=idx)
+            logits = _lm_head(spec, params, hidden)
+            nxt = sampling_ops.sample_dp(
+                logits[:, -1, :], tpu_cfg.on_device_sampling_config,
+                sampling_params, step_rng)
+            return (nxt, pos + 1, rpos + 1 if use_mrope else rpos,
+                    sk, sv), nxt
+
+        (_, _, _, sk, sv), toks = jax.lax.scan(
+            step, (first_tokens, position_ids, rope_position_ids,
+                   side_k0, side_v0),
+            (rngs, jnp.arange(num_steps, dtype=jnp.int32)),
+            unroll=int(os.environ.get("NXDI_TPU_DECODE_UNROLL", "2")))
+        new_cache = {
+            "k": kv.commit_chunk(
+                cache["k"], kv.quantize_kv(sk, cache["k"].dtype,
+                                           spec.kv_scale),
+                seq_ids, start_pos, k_transposed=True),
+            "v": kv.commit_chunk(
+                cache["v"], kv.quantize_kv(sv, cache["v"].dtype,
+                                           spec.kv_scale),
+                seq_ids, start_pos),
+        }
+        return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
 
     def step(carry, step_rng):
         tok, pos, rpos, cch = carry
@@ -1303,10 +1472,6 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         return (nxt, pos + 1, rpos + 1 if use_mrope else rpos,
                 out["cache"]), nxt
 
-    if rope_position_ids is None:
-        rope_position_ids = jnp.zeros(
-            (first_tokens.shape[0], 3), position_ids.dtype)
-    rngs = jax.random.split(rng, num_steps)
     (_, _, _, new_cache), toks = jax.lax.scan(
         step, (first_tokens, position_ids, rope_position_ids, cache), rngs)
     return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}

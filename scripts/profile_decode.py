@@ -84,7 +84,7 @@ def make_layers_only(n):
             h_sum, pos, cch = carry
             ai = model_base.attn_inputs(
                 spec, pos[:, None],
-                lambda w: jnp.ones((batch, 1, seq_len), bool))
+                lambda w, c: jnp.ones((batch, 1, seq_len), bool))
             hidden = model_base._embed(spec, params,
                                        jnp.zeros((batch, 1), jnp.int32))
             hidden, new_cache, _ = model_base.run_layers(
@@ -112,18 +112,18 @@ def make_lm_head_only(n):
 
 def make_attn_only(n):
     from neuronx_distributed_inference_tpu.ops import attention as attn_ops
+    from neuronx_distributed_inference_tpu.modules import kv_cache as kvm
     def attn_only(params, cache):
         def step(carry, _):
             acc, cch = carry
-            def body(c2, xs):
-                kc, vc = xs                       # (B, H, S, D) head-leading
-                kc = jnp.swapaxes(kc, 1, 2)
-                vc = jnp.swapaxes(vc, 1, 2)
+            acc2 = acc
+            for li in range(spec.num_layers):  # decode unrolls layers too
+                k_layer = kvm.read_layer_hl(cch["k"], li)   # (B, H, D, S)
+                v_layer = kvm.read_layer_hl(cch["v"], li)   # (B, H, S, D)
                 q = jnp.full((batch, 1, spec.gqa.num_q_heads, spec.head_dim),
-                             c2 * 1e-9 + 1.0, jnp.bfloat16)
-                o = attn_ops.mha(q, kc, vc, None, spec.scale)
-                return c2 + o.sum().astype(jnp.float32), None
-            acc2, _ = jax.lax.scan(body, acc, (cch["k"], cch["v"]))
+                             acc2 * 1e-9 + 1.0, jnp.bfloat16)
+                o = attn_ops.mha_hl(q, k_layer, v_layer, None, spec.scale)
+                acc2 = acc2 + o.sum().astype(jnp.float32)
             return (acc2, cch), None
         (s, _), _ = jax.lax.scan(step, (jnp.zeros((), jnp.float32), cache),
                                  None, length=n)
