@@ -603,7 +603,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 mlp_kind: Optional[str] = None,
                 adapter_ids=None, replace=None, kv_view: int = None,
                 deepstack=None, deepstack_mask=None, prefill_lens=None,
-                side=None, chunk_idx=None):
+                side=None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -719,14 +719,39 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         v_full = bkv.write_slots_at_layer(
             v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale), li,
             slot_mapping)
-        k_all = kv.dequantize_kv(
-            bkv.gather_block_kv(bkv.read_layer(k_full, li), block_table),
-            dtype, spec.kv_scale)
-        v_all = kv.dequantize_kv(
-            bkv.gather_block_kv(bkv.read_layer(v_full, li), block_table),
-            dtype, spec.kv_scale)
-        attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
-                                logits_soft_cap=spec.attn_soft_cap, sink=sink)
+        # ragged paged decode kernel (reference: DMA-skipping TKG attention
+        # over the block layout, attention_base.py:1186-1382): reads only
+        # each row's LIVE pages through the block table — the gather path
+        # below materializes the whole table per layer per token. Default-on
+        # for single-token paged decode (decode_kernel None/True).
+        use_pkernel = (hidden.shape[1] == 1
+                       and spec.decode_kernel is not False
+                       and decode_attention.supports(spec, 1)
+                       and spec.kv_scale is None and k_full.dtype == dtype)
+        if use_pkernel:
+            if spec.layer_pattern is not None:
+                win = jnp.where(is_local, spec.sliding_window, 0)
+            else:
+                win = jnp.asarray(spec.sliding_window, jnp.int32)
+            kernel_out = decode_attention.paged_dispatch(
+                q[:, 0], k_full, v_full, k[:, 0], v[:, 0], li,
+                positions[:, 0], block_table, scale=spec.scale, window=win,
+                soft_cap=spec.attn_soft_cap, sink=sink,
+                interpret=jax.default_backend() != "tpu")
+            if kernel_out is None:
+                use_pkernel = False
+            else:
+                attn_out = kernel_out[:, None]
+        if not use_pkernel:
+            k_all = kv.dequantize_kv(
+                bkv.gather_block_kv(bkv.read_layer(k_full, li), block_table),
+                dtype, spec.kv_scale)
+            v_all = kv.dequantize_kv(
+                bkv.gather_block_kv(bkv.read_layer(v_full, li), block_table),
+                dtype, spec.kv_scale)
+            attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
+                                    logits_soft_cap=spec.attn_soft_cap,
+                                    sink=sink)
     elif phase == "prefill":
         # flash kernel requirements beyond supports(): per-row positions must
         # be arange (the kernel rebuilds causality from array indices — an
@@ -1114,7 +1139,7 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
                 (jax.tree.map(lambda a: a[i], rep)
                  if replacements is not None else None),
                 kv_view=kv_view, prefill_lens=prefill_lens,
-                side=side, chunk_idx=chunk_idx)
+                side=side)
             if side is not None:
                 hidden, kf, vf, caps_i, pending = res
                 pend.append(pending)

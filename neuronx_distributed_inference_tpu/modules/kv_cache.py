@@ -231,10 +231,11 @@ def commit_chunk(cache: jnp.ndarray, side: jnp.ndarray,
 
     cache (L, B, H, D, S) transposed-K or (L, B, H, S, D);
     side (L, b, H, D, C) / (L, b, H, C, D); start_positions (b,) — row i's
-    chunk covers positions [start, start+C). A chunk that would not fit
-    entirely inside the cache keeps the old values (drop semantics, as in
-    :func:`write_tokens_at_layer` — clipping instead would silently
-    overwrite live earlier slots).
+    chunk covers positions [start, start+C). A row whose chunk would
+    straddle the cache end keeps its OLD values for the whole chunk
+    (unlike write_tokens_at_layer's per-token drop): callers must size
+    chunks so start+C <= S — the application's bucket selection
+    (application.py _kv_bucket over position+num_steps) guarantees this.
     """
     C = side.shape[4] if k_transposed else side.shape[3]
     s_max = cache.shape[4] if k_transposed else cache.shape[3]

@@ -322,6 +322,242 @@ def dispatch(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
                          out_specs=P(dp, mpx, None), check_vma=False)(*args)
 
 
+def _paged_kernel(sc_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
+                  o_ref, acc_ref, m_ref, l_ref, *,
+                  scale: float, block_s: int, nh: int,
+                  soft_cap: Optional[float], has_sink: bool):
+    """Ragged PAGED decode attention (reference: the DMA-skipping TKG
+    attention over the block layout, attention_base.py:1186-1382 +
+    block_kv_cache_manager.py:183-267). Scalar layout:
+    [layer, window, len_0..len_{B-1}, table_{b=0,j=0}.., table_{B-1,mb-1}]
+    — the index maps gather PHYSICAL pages through the block table, so the
+    kernel streams only each row's live pages (grid steps past the live
+    range collapse onto the last live page and Pallas elides the DMA); the
+    XLA gather path materializes the whole table every layer every token."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    pos = sc_ref[2 + b]
+    w = sc_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    k_start = j * block_s
+    in_window = jnp.logical_or(w == 0, k_start + block_s > pos - w)
+
+    @pl.when(jnp.logical_and(k_start < pos, in_window))
+    def _prior():
+        kpos = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (q_ref.shape[3], block_s), 1)
+        valid = kpos < pos
+        valid = jnp.logical_and(
+            valid, jnp.logical_or(w == 0, pos - kpos < w))
+        for hh in range(nh):
+            q = q_ref[0, 0, hh].astype(jnp.float32)        # (G, D)
+            k = k_ref[0, 0, :, hh, :].astype(jnp.float32)  # (bs, D)
+            v = v_ref[0, 0, :, hh, :].astype(jnp.float32)  # (bs, D)
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)      # (G, bs)
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[hh, :, 0:1]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur)
+            l_ref[hh, :, 0:1] = (l_ref[hh, :, 0:1] * alpha
+                                 + jnp.sum(p, -1, keepdims=True))
+            acc_ref[hh] = acc_ref[hh] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[hh, :, 0:1] = m_cur
+
+    @pl.when(j == nj - 1)
+    def _active_and_finalize():
+        for hh in range(nh):
+            q = q_ref[0, 0, hh].astype(jnp.float32)        # (G, D)
+            kn = nk_ref[0, 0, hh].astype(jnp.float32)      # (1, D)
+            vn = nv_ref[0, 0, hh].astype(jnp.float32)      # (1, D)
+            s = jax.lax.dot_general(q, kn, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32) * scale
+            if soft_cap is not None:
+                s = soft_cap * jnp.tanh(s / soft_cap)      # (G, 1)
+            m_prev = m_ref[hh, :, 0:1]
+            m_cur = jnp.maximum(m_prev, s)
+            if has_sink:
+                sk = sink_ref[0, hh].astype(jnp.float32).reshape(-1)[:, None]
+                m_cur = jnp.maximum(m_cur, sk)
+            alpha = jnp.exp(m_prev - m_cur)
+            p = jnp.exp(s - m_cur)
+            l_new = l_ref[hh, :, 0:1] * alpha + p
+            if has_sink:
+                l_new = l_new + jnp.exp(sk - m_cur)
+            acc = acc_ref[hh] * alpha + p * vn
+            o_ref[0, 0, hh] = (acc / l_new).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "soft_cap", "interpret"))
+def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
+                           v_pages: jnp.ndarray, new_k: jnp.ndarray,
+                           new_v: jnp.ndarray, layer: jnp.ndarray,
+                           lens: jnp.ndarray, block_table: jnp.ndarray, *,
+                           scale: float,
+                           window: Optional[jnp.ndarray] = None,
+                           soft_cap: Optional[float] = None,
+                           sink: Optional[jnp.ndarray] = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """Ragged paged decode attention over the stacked block cache.
+
+    q (B, Hq, D); k_pages/v_pages (L, N, Bs, Hkv, D); new_k/new_v
+    (B, Hkv, D); lens (B,) prior lengths; block_table (B, max_blocks)
+    logical→physical page map (entry 0 = null page). Returns (B, Hq, D).
+    """
+    b, hq, d = q.shape
+    hkv = k_pages.shape[3]
+    bs = k_pages.shape[2]
+    mb = block_table.shape[1]
+    g = hq // hkv
+
+    vmem_budget = 4 * 1024 * 1024
+    max_nh = max(1, min(8, vmem_budget // (bs * d * 2 * 2 * 2)))
+    nh = 1
+    for cand in range(max_nh, 0, -1):
+        if hkv % cand == 0:
+            nh = cand
+            break
+    hb = hkv // nh
+
+    qr = q.reshape(b, hb, nh, g, d)
+    sink_in = (sink.reshape(hb, nh, 1, g) if sink is not None
+               else jnp.zeros((hb, nh, 1, g), jnp.float32))
+
+    def q_map(bi, h, j, sc):
+        return (bi, h, 0, 0, 0)
+
+    def _live_page(bi, j, sc):
+        pos_b = sc[2 + bi]
+        last_live = jax.lax.max(
+            jax.lax.div(jax.lax.max(pos_b - 1, 0), bs), 0)
+        w = sc[1]
+        first_live = jax.lax.select(
+            w > 0, jax.lax.max(jax.lax.div(jax.lax.max(pos_b - w, 0), bs),
+                               0), 0)
+        jc = jax.lax.min(jax.lax.max(j, first_live), last_live)
+        return sc[2 + b + bi * mb + jc]         # physical page id
+
+    def kv_map(bi, h, j, sc):
+        # pages (L, N, Bs, Hkv, D): full Bs rows, nh-head slab
+        return (sc[0], _live_page(bi, j, sc), 0, h, 0)
+
+    def nkv_map(bi, h, j, sc):
+        return (bi, h, 0, 0, 0)
+
+    def sink_map(bi, h, j, sc):
+        return (h, 0, 0, 0)
+
+    grid = (b, hb, mb)
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, block_s=bs, nh=nh,
+        soft_cap=soft_cap, has_sink=sink is not None)
+    if window is None:
+        window = jnp.zeros((), jnp.int32)
+    scalars = jnp.concatenate([
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        jnp.asarray(window, jnp.int32).reshape(1),
+        lens.astype(jnp.int32),
+        block_table.astype(jnp.int32).reshape(-1)])
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, nh, g, d), q_map),
+                pl.BlockSpec((1, 1, bs, nh, d), kv_map),
+                pl.BlockSpec((1, 1, bs, nh, d), kv_map),
+                pl.BlockSpec((1, 1, nh, 1, d), nkv_map),
+                pl.BlockSpec((1, 1, nh, 1, d), nkv_map),
+                pl.BlockSpec((1, nh, 1, g), sink_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, nh, g, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((nh, g, d), jnp.float32),
+                pltpu.VMEM((nh, g, 128), jnp.float32),
+                pltpu.VMEM((nh, g, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hb, nh, g, d), q.dtype),
+        interpret=interpret,
+    )(scalars, qr, k_pages, v_pages,
+      new_k.reshape(b, hb, nh, 1, d), new_v.reshape(b, hb, nh, 1, d),
+      sink_in)
+    return out.reshape(b, hq, d)
+
+
+def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                   new_k: jnp.ndarray, new_v: jnp.ndarray, layer: jnp.ndarray,
+                   lens: jnp.ndarray, block_table: jnp.ndarray, *,
+                   scale: float, window: Optional[jnp.ndarray] = None,
+                   soft_cap: Optional[float] = None,
+                   sink: Optional[jnp.ndarray] = None,
+                   interpret: bool = False) -> Optional[jnp.ndarray]:
+    """Mesh-aware entry for the paged kernel: shard kv-heads over the
+    model-parallel axes, matching the block-cache sharding
+    P(None, None, None, ("ep","tp"), None) (modules/block_kv_cache.py).
+    Returns None when the heads cannot be sharded over a >1 mp degree."""
+    mesh = jax.sharding.get_abstract_mesh()
+    hkv = k_pages.shape[3]
+    mp_axes = tuple(a for a in ("ep", "tp")
+                    if mesh is not None and a in mesh.axis_names
+                    and mesh.shape[a] > 1)
+    mp = 1
+    for a in mp_axes:
+        mp *= mesh.shape[a]
+    if mp > 1 and hkv % mp != 0:
+        return None
+    if not mp_axes:
+        return paged_decode_attention(
+            q, k_pages, v_pages, new_k, new_v, layer, lens, block_table,
+            scale=scale, window=window, soft_cap=soft_cap, sink=sink,
+            interpret=interpret)
+
+    if window is None:
+        window = jnp.zeros((), jnp.int32)
+    from jax.sharding import PartitionSpec as P
+    mpx = mp_axes
+    in_specs = [
+        P(None, mpx, None),                  # q
+        P(None, None, None, mpx, None),      # k_pages
+        P(None, None, None, mpx, None),      # v_pages
+        P(None, mpx, None),                  # new_k
+        P(None, mpx, None),                  # new_v
+        P(),                                 # layer
+        P(None),                             # lens
+        P(None, None),                       # block_table
+        P(),                                 # window
+    ]
+    args = [q, k_pages, v_pages, new_k, new_v, layer, lens, block_table,
+            jnp.asarray(window, jnp.int32)]
+    if sink is not None:
+        in_specs.append(P(mpx))
+        args.append(sink)
+
+    def body(q, kp, vp, nk, nv, layer, lens, table, window, *rest):
+        return paged_decode_attention(
+            q, kp, vp, nk, nv, layer, lens, table, scale=scale,
+            window=window, soft_cap=soft_cap,
+            sink=rest[0] if rest else None, interpret=interpret)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                         out_specs=P(None, mpx, None), check_vma=False)(*args)
+
+
 def supports(spec, phase_t: int) -> bool:
     """Kernel admission (reference analog: TKG kernel enablement flags,
     models/config.py:417-567): single active token, no MLA (different head

@@ -209,3 +209,32 @@ def test_paged_chunked_decode_matches_single_step(cfg_pair):
     got = app4.generate(ids, max_new_tokens=9)
     np.testing.assert_array_equal(got["sequences"], ref["sequences"])
     assert ("paged_loop", 4) in app4._compiled
+
+
+def test_paged_ragged_kernel_e2e_matches_contiguous():
+    """head_dim=64 admits the ragged paged decode kernel
+    (ops/decode_attention.paged_decode_attention, default-on for paged
+    decode) — paged generate must still match the contiguous app."""
+    hf = dict(model_type="llama", hidden_size=256, intermediate_size=512,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, head_dim=64, vocab_size=512,
+              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
+              tie_word_embeddings=False, torch_dtype="float32")
+    base = dict(batch_size=2, seq_len=64, dtype="float32",
+                enable_bucketing=False)
+    app_c = CausalLMApplication(None, LlamaInferenceConfig(
+        TpuConfig(**base), **hf), LlamaFamily)
+    app_c.init_random_weights(3).init_cache()
+    app_p = PagedCausalLMApplication(None, LlamaInferenceConfig(
+        TpuConfig(**base, is_block_kv_layout=True, pa_block_size=8), **hf),
+        LlamaFamily)
+    app_p.init_random_weights(3).init_cache()
+    assert app_p.spec.head_dim == 64 and app_p.spec.decode_kernel is None
+
+    ids = np.random.default_rng(1).integers(1, 512, size=(2, 13),
+                                            dtype=np.int64)
+    mask = np.ones_like(ids); mask[1, 10:] = 0; ids[1, 10:] = 0
+    want = app_c.generate(ids, attention_mask=mask, max_new_tokens=10)
+    got = app_p.generate(ids, attention_mask=mask, max_new_tokens=10)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    app_p.release()

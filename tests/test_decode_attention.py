@@ -292,3 +292,116 @@ def test_decode_attention_bf16_io(rng):
     assert got.dtype == jnp.bfloat16
     np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(want),
                                rtol=0.05, atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# Ragged paged decode kernel (ops/decode_attention.paged_decode_attention)
+# ---------------------------------------------------------------------------
+
+def _paged_reference(q, k_pages, v_pages, nk, nv, lens, table, scale,
+                     window=0, soft_cap=None, sink=None):
+    """XLA gather-path reference (what model_base paged_forward_step does on
+    the non-kernel branch): gather the whole block table, write the active
+    token at each row's position, mha with the decode mask."""
+    from neuronx_distributed_inference_tpu.modules import block_kv_cache as bkv
+    li = 0
+    k_all = np.array(bkv.gather_block_kv(k_pages[li], jnp.asarray(table)))
+    v_all = np.array(bkv.gather_block_kv(v_pages[li], jnp.asarray(table)))
+    b = q.shape[0]
+    rows = np.arange(b)
+    k_all[rows, np.asarray(lens)] = np.asarray(nk)
+    v_all[rows, np.asarray(lens)] = np.asarray(nv)
+    positions = jnp.asarray(lens)[:, None]
+    mask = attn_ops.decode_mask(positions, k_all.shape[1], window=window)
+    out = attn_ops.mha(q[:, None], jnp.asarray(k_all), jnp.asarray(v_all),
+                       mask, scale, logits_soft_cap=soft_cap, sink=sink)
+    return out[:, 0]
+
+
+def _paged_setup(rng, b, hq, hkv, d, bs, mb, lens, num_blocks=None):
+    """Random pages + a block table assigning distinct physical pages in a
+    scrambled order (block 0 = null)."""
+    n = num_blocks or (1 + b * mb)
+    k_pages = _rand(rng, 1, n, bs, hkv, d)
+    v_pages = _rand(rng, 1, n, bs, hkv, d)
+    perm = rng.permutation(n - 1)[:b * mb] + 1
+    table = np.zeros((b, mb), np.int32)
+    for i in range(b):
+        live = -(-int(lens[i] + 1) // bs)
+        table[i, :live] = perm[i * mb:i * mb + live]
+    q = _rand(rng, b, hq, d)
+    nk = _rand(rng, b, hkv, d)
+    nv = _rand(rng, b, hkv, d)
+    return q, k_pages, v_pages, nk, nv, table
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (8, 2)])
+def test_paged_decode_kernel_matches_gather_path(rng, hq, hkv):
+    b, d, bs, mb = 3, 64, 32, 8
+    lens = np.array([5, 100, 255], np.int32)
+    q, kp, vp, nk, nv, table = _paged_setup(rng, b, hq, hkv, d, bs, mb, lens)
+    scale = d ** -0.5
+    got = da.paged_decode_attention(
+        q, kp, vp, nk, nv, jnp.asarray(0, jnp.int32),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(table), scale=scale,
+        interpret=True)
+    want = _paged_reference(q, kp, vp, nk, nv, lens, table, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_kernel_window_and_sink(rng):
+    b, hq, hkv, d, bs, mb = 2, 4, 2, 64, 32, 8
+    lens = np.array([200, 90], np.int32)
+    q, kp, vp, nk, nv, table = _paged_setup(rng, b, hq, hkv, d, bs, mb, lens)
+    scale = d ** -0.5
+    sink = _rand(rng, hq)
+    got = da.paged_decode_attention(
+        q, kp, vp, nk, nv, jnp.asarray(0, jnp.int32),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(table), scale=scale,
+        window=jnp.asarray(64, jnp.int32), sink=sink, interpret=True)
+    want = _paged_reference(q, kp, vp, nk, nv, lens, table, scale,
+                            window=64, sink=sink)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_kernel_zero_len_row(rng):
+    b, hq, hkv, d, bs, mb = 2, 4, 2, 64, 32, 4
+    lens = np.array([0, 60], np.int32)
+    q, kp, vp, nk, nv, table = _paged_setup(rng, b, hq, hkv, d, bs, mb, lens)
+    scale = d ** -0.5
+    got = da.paged_decode_attention(
+        q, kp, vp, nk, nv, jnp.asarray(0, jnp.int32),
+        jnp.asarray(lens, jnp.int32), jnp.asarray(table), scale=scale,
+        interpret=True)
+    want = _paged_reference(q, kp, vp, nk, nv, lens, table, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_kernel_stacked_layers(rng):
+    """Layer addressing through scalar prefetch on the stacked page cache."""
+    L, b, hq, hkv, d, bs, mb = 3, 2, 4, 2, 64, 32, 4
+    lens = np.array([40, 100], np.int32)
+    n = 1 + b * mb
+    kp = _rand(rng, L, n, bs, hkv, d)
+    vp = _rand(rng, L, n, bs, hkv, d)
+    perm = rng.permutation(n - 1)[:b * mb] + 1
+    table = np.zeros((b, mb), np.int32)
+    for i in range(b):
+        live = -(-int(lens[i] + 1) // bs)
+        table[i, :live] = perm[i * mb:i * mb + live]
+    q = _rand(rng, b, hq, d)
+    nk = _rand(rng, b, hkv, d)
+    nv = _rand(rng, b, hkv, d)
+    scale = d ** -0.5
+    for li in range(L):
+        got = da.paged_decode_attention(
+            q, kp, vp, nk, nv, jnp.asarray(li, jnp.int32),
+            jnp.asarray(lens, jnp.int32), jnp.asarray(table), scale=scale,
+            interpret=True)
+        want = _paged_reference(q, kp[li:li + 1], vp[li:li + 1], nk, nv,
+                                lens, table, scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5, err_msg=f"layer {li}")
