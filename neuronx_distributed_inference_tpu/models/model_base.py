@@ -58,6 +58,9 @@ ACT_FNS = {
     "gelu": partial(jax.nn.gelu, approximate=False),
     "gelu_new": partial(jax.nn.gelu, approximate=True),
     "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
+    "relu": jax.nn.relu,
+    # squared ReLU (nemotron / arcee plain MLPs)
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),
 }
 
 
@@ -203,6 +206,9 @@ class DecoderSpec:
     qkv_clip: Optional[float] = None
     # interleaved (GPT-NeoX pair) rope convention (deepseek rope_interleave)
     rope_interleaved: bool = False
+    # apply the per-head q/k RMSNorm AFTER rope instead of before
+    # (hunyuan-dense query/key_layernorm ordering)
+    qk_norm_after_rope: bool = False
     # Medusa speculation heads on the target model (reference:
     # medusa_speculation, model_base.py / models/config.py:243-274):
     # head j = ResBlock(H->H) + its own lm head, predicting position +j+2
@@ -587,6 +593,10 @@ def attn_inputs(spec: DecoderSpec, position_ids, make_mask,
         return ai
     ai["mask"] = make_mask(0, 0)
     cos_l, sin_l = rope_cos_sin(rp, spec.local_rope or spec.rope)
+    if spec.no_rope:
+        # learned-position models with local/global patterns (gpt-neo):
+        # neither variant rotates
+        cos_l, sin_l = jnp.ones_like(cos_l), jnp.zeros_like(sin_l)
     if spec.nope_global:
         # llama4 NoPE global layers: identity rotation
         ai["cos"], ai["sin"] = jnp.ones_like(cos), jnp.zeros_like(sin)
@@ -682,11 +692,14 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                    AXIS_DP, q_seq_axis, AXIS_MP, None)
         k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
         v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
-        if spec.qk_norm:
+        if spec.qk_norm and not spec.qk_norm_after_rope:
             q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
             k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         q = apply_rope(q, cos, sin, interleaved=spec.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=spec.rope_interleaved)
+        if spec.qk_norm and spec.qk_norm_after_rope:
+            q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+            k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         if spec.qk_l2_norm:
             # llama4: weightless L2 norm AFTER rope, rope (local) layers only
             def _l2(x):

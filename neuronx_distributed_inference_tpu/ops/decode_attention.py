@@ -512,6 +512,7 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     P(None, None, None, ("ep","tp"), None) (modules/block_kv_cache.py).
     Returns None when the heads cannot be sharded over a >1 mp degree."""
     mesh = jax.sharding.get_abstract_mesh()
+    b = q.shape[0]
     hkv = k_pages.shape[3]
     mp_axes = tuple(a for a in ("ep", "tp")
                     if mesh is not None and a in mesh.axis_names
@@ -521,7 +522,12 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         mp *= mesh.shape[a]
     if mp > 1 and hkv % mp != 0:
         return None
-    if not mp_axes:
+    # batch rows split over dp (pages stay replicated across dp — the
+    # block cache has no dp axis, block_cache_pspec)
+    dp_axes = tuple(a for a in ("dp",)
+                    if mesh is not None and a in mesh.axis_names
+                    and mesh.shape[a] > 1 and b % mesh.shape[a] == 0)
+    if not mp_axes and not dp_axes:
         return paged_decode_attention(
             q, k_pages, v_pages, new_k, new_v, layer, lens, block_table,
             scale=scale, window=window, soft_cap=soft_cap, sink=sink,
@@ -530,16 +536,17 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
     if window is None:
         window = jnp.zeros((), jnp.int32)
     from jax.sharding import PartitionSpec as P
-    mpx = mp_axes
+    mpx = mp_axes if mp_axes else None
+    dp = dp_axes if dp_axes else None
     in_specs = [
-        P(None, mpx, None),                  # q
+        P(dp, mpx, None),                    # q
         P(None, None, None, mpx, None),      # k_pages
         P(None, None, None, mpx, None),      # v_pages
-        P(None, mpx, None),                  # new_k
-        P(None, mpx, None),                  # new_v
+        P(dp, mpx, None),                    # new_k
+        P(dp, mpx, None),                    # new_v
         P(),                                 # layer
-        P(None),                             # lens
-        P(None, None),                       # block_table
+        P(dp),                               # lens
+        P(dp, None),                         # block_table
         P(),                                 # window
     ]
     args = [q, k_pages, v_pages, new_k, new_v, layer, lens, block_table,
@@ -555,7 +562,7 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
             sink=rest[0] if rest else None, interpret=interpret)
 
     return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                         out_specs=P(None, mpx, None), check_vma=False)(*args)
+                         out_specs=P(dp, mpx, None), check_vma=False)(*args)
 
 
 def supports(spec, phase_t: int) -> bool:

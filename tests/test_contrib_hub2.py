@@ -1,0 +1,214 @@
+"""Golden tests for contrib hub wave 2 (reference: contrib/models/ — SURVEY
+§2.7): tiny random-weight HF model vs the converted app, teacher-forced
+logits + decisive-margin token equality."""
+
+import numpy as np
+import pytest
+import torch
+
+from test_contrib_hub import _check
+
+
+def test_gptj_matches_hf(tmp_path):
+    from transformers import GPTJConfig, GPTJForCausalLM
+    torch.manual_seed(0)
+    cfg = GPTJConfig(n_embd=64, n_head=4, n_layer=3, n_positions=128,
+                     rotary_dim=8, vocab_size=256, resid_pdrop=0.0,
+                     embd_pdrop=0.0, attn_pdrop=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "gptj", GPTJForCausalLM(cfg))
+    assert app.spec.block_style == "parallel_shared"
+    assert app.spec.rope_interleaved and app.spec.rope.rotary_dim == 8
+    assert app.spec.lm_head_bias
+
+
+def test_gpt_neo_matches_hf(tmp_path):
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+    torch.manual_seed(0)
+    cfg = GPTNeoConfig(hidden_size=64, num_heads=4, num_layers=4,
+                       attention_types=[[["global", "local"], 2]],
+                       window_size=8, vocab_size=256,
+                       max_position_embeddings=128,
+                       resid_dropout=0.0, embed_dropout=0.0,
+                       attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "gpt_neo", GPTNeoForCausalLM(cfg))
+    assert app.spec.layer_pattern == (False, True, False, True)
+    assert app.spec.sliding_window == 8 and app.spec.no_rope
+    assert app.spec.attn_scale == 1.0
+
+
+def test_gpt_bigcode_matches_hf(tmp_path):
+    from transformers import GPTBigCodeConfig, GPTBigCodeForCausalLM
+    torch.manual_seed(0)
+    cfg = GPTBigCodeConfig(n_embd=64, n_head=4, n_layer=3, n_positions=128,
+                           multi_query=True, vocab_size=256,
+                           resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+                           torch_dtype="float32")
+    app = _check(tmp_path, "gpt_bigcode", GPTBigCodeForCausalLM(cfg))
+    assert app.spec.num_kv_heads == 1 and app.spec.no_rope
+
+
+def test_opt_matches_hf(tmp_path):
+    from transformers import OPTConfig, OPTForCausalLM
+    torch.manual_seed(0)
+    cfg = OPTConfig(hidden_size=64, num_attention_heads=4,
+                    num_hidden_layers=3, ffn_dim=128, vocab_size=256,
+                    max_position_embeddings=128, word_embed_proj_dim=64,
+                    do_layer_norm_before=True, dropout=0.0,
+                    attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "opt", OPTForCausalLM(cfg))
+    assert app.spec.act == "relu" and app.spec.learned_pos == 128
+
+
+def test_biogpt_matches_hf(tmp_path):
+    from transformers import BioGptConfig, BioGptForCausalLM
+    torch.manual_seed(0)
+    cfg = BioGptConfig(hidden_size=64, num_attention_heads=4,
+                       num_hidden_layers=3, intermediate_size=128,
+                       vocab_size=256, max_position_embeddings=128,
+                       scale_embedding=True, hidden_dropout_prob=0.0,
+                       attention_probs_dropout_prob=0.0,
+                       activation_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "biogpt", BioGptForCausalLM(cfg))
+    assert app.spec.embed_scale == 8.0
+
+
+def test_xglm_matches_hf(tmp_path):
+    from transformers import XGLMConfig, XGLMForCausalLM
+    torch.manual_seed(0)
+    cfg = XGLMConfig(d_model=64, attention_heads=4, num_layers=3,
+                     ffn_dim=128, vocab_size=256,
+                     max_position_embeddings=128, dropout=0.0,
+                     attention_dropout=0.0, activation_dropout=0.0,
+                     layerdrop=0.0, scale_embedding=True,
+                     torch_dtype="float32")
+    _check(tmp_path, "xglm", XGLMForCausalLM(cfg))
+
+
+def test_helium_matches_hf(tmp_path):
+    from transformers import HeliumConfig, HeliumForCausalLM
+    torch.manual_seed(0)
+    cfg = HeliumConfig(hidden_size=64, num_attention_heads=4,
+                       num_key_value_heads=2, num_hidden_layers=3,
+                       intermediate_size=128, head_dim=16, vocab_size=256,
+                       attention_dropout=0.0, torch_dtype="float32")
+    # fp32 accumulation-order noise reaches ~7e-3 on one logit
+    _check(tmp_path, "helium", HeliumForCausalLM(cfg), atol=1.2e-2)
+
+
+def test_ernie4_5_matches_hf(tmp_path):
+    from transformers import Ernie4_5Config, Ernie4_5ForCausalLM
+    torch.manual_seed(0)
+    # Ernie4_5Config serializes its (True) tie default as null — set it
+    cfg = Ernie4_5Config(hidden_size=64, num_attention_heads=4,
+                         num_key_value_heads=2, num_hidden_layers=3,
+                         intermediate_size=128, vocab_size=256,
+                         tie_word_embeddings=True, torch_dtype="float32")
+    _check(tmp_path, "ernie4_5", Ernie4_5ForCausalLM(cfg))
+
+
+def test_seed_oss_matches_hf(tmp_path):
+    from transformers import SeedOssConfig, SeedOssForCausalLM
+    torch.manual_seed(0)
+    cfg = SeedOssConfig(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=3,
+                        intermediate_size=128, head_dim=16, vocab_size=256,
+                        attention_bias=True, attention_dropout=0.0,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "seed_oss", SeedOssForCausalLM(cfg))
+    assert app.spec.qkv_bias
+
+
+def test_arcee_matches_hf(tmp_path):
+    from transformers import ArceeConfig, ArceeForCausalLM
+    torch.manual_seed(0)
+    cfg = ArceeConfig(hidden_size=64, num_attention_heads=4,
+                      num_key_value_heads=2, num_hidden_layers=3,
+                      intermediate_size=128, vocab_size=256,
+                      hidden_act="relu2", torch_dtype="float32")
+    app = _check(tmp_path, "arcee", ArceeForCausalLM(cfg))
+    assert app.spec.act == "relu2" and not app.spec.mlp_glu
+
+
+def test_nemotron_matches_hf(tmp_path):
+    from transformers import NemotronConfig, NemotronForCausalLM
+    torch.manual_seed(0)
+    cfg = NemotronConfig(hidden_size=64, num_attention_heads=4,
+                         num_key_value_heads=2, num_hidden_layers=3,
+                         intermediate_size=128, vocab_size=256,
+                         hidden_act="relu2", partial_rotary_factor=0.5,
+                         attention_dropout=0.0, hidden_dropout=0.0,
+                         torch_dtype="float32")
+    app = _check(tmp_path, "nemotron", NemotronForCausalLM(cfg))
+    assert app.spec.rope.rotary_dim == 8 and app.spec.norm_type == "layernorm"
+
+
+def test_smollm3_matches_hf(tmp_path):
+    from transformers import SmolLM3Config, SmolLM3ForCausalLM
+    torch.manual_seed(0)
+    cfg = SmolLM3Config(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=4,
+                        intermediate_size=128, vocab_size=256,
+                        pad_token_id=0, no_rope_layer_interval=2,
+                        tie_word_embeddings=True, attention_dropout=0.0,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "smollm3", SmolLM3ForCausalLM(cfg))
+    assert app.spec.layer_pattern is not None and app.spec.nope_global
+
+
+def test_cohere2_matches_hf(tmp_path):
+    from transformers import Cohere2Config, Cohere2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Cohere2Config(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=4,
+                        intermediate_size=128, vocab_size=256,
+                        sliding_window=8, sliding_window_pattern=2,
+                        layer_types=["sliding_attention", "full_attention",
+                                     "sliding_attention", "full_attention"],
+                        logit_scale=0.25, attention_dropout=0.0,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "cohere2", Cohere2ForCausalLM(cfg))
+    assert app.spec.layer_pattern == (True, False, True, False)
+    assert app.spec.block_style == "parallel_shared" and app.spec.nope_global
+
+
+def test_exaone4_matches_hf(tmp_path):
+    from transformers import Exaone4Config, Exaone4ForCausalLM
+    torch.manual_seed(0)
+    cfg = Exaone4Config(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=4,
+                        intermediate_size=128, head_dim=16, vocab_size=256,
+                        sliding_window=8,
+                        layer_types=["sliding_attention", "sliding_attention",
+                                     "sliding_attention", "full_attention"],
+                        attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "exaone4", Exaone4ForCausalLM(cfg))
+    assert app.spec.norm_position == "post" and app.spec.qk_norm
+    assert app.spec.layer_pattern == (True, True, True, False)
+
+
+def test_hunyuan_dense_matches_hf(tmp_path):
+    from transformers import HunYuanDenseV1Config, HunYuanDenseV1ForCausalLM
+    torch.manual_seed(0)
+    cfg = HunYuanDenseV1Config(hidden_size=64, num_attention_heads=4,
+                               num_key_value_heads=2, num_hidden_layers=3,
+                               intermediate_size=128, head_dim=16,
+                               vocab_size=256, attention_dropout=0.0,
+                               torch_dtype="float32")
+    app = _check(tmp_path, "hunyuan_v1_dense", HunYuanDenseV1Config and
+                 HunYuanDenseV1ForCausalLM(cfg))
+    assert app.spec.qk_norm and app.spec.qk_norm_after_rope
+
+
+def test_granitemoe_matches_hf(tmp_path):
+    from transformers import GraniteMoeConfig, GraniteMoeForCausalLM
+    torch.manual_seed(0)
+    cfg = GraniteMoeConfig(hidden_size=64, num_attention_heads=4,
+                           num_key_value_heads=2, num_hidden_layers=3,
+                           intermediate_size=64, vocab_size=256,
+                           num_local_experts=4, num_experts_per_tok=2,
+                           embedding_multiplier=2.0, logits_scaling=2.0,
+                           residual_multiplier=0.5,
+                           attention_multiplier=0.25,
+                           attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "granitemoe", GraniteMoeForCausalLM(cfg))
+    assert app.spec.moe is not None and app.spec.moe.pre_softmax_topk
