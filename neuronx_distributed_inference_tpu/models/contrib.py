@@ -670,6 +670,12 @@ class CohereFamily(DecoderFamily):
     post_norm_src = "input_layernorm"   # parallel_shared: post_norm unused
 
 
+def _vpad(w: np.ndarray, padded: int) -> np.ndarray:
+    if w.shape[0] < padded:
+        w = np.pad(w, [(0, padded - w.shape[0])] + [(0, 0)] * (w.ndim - 1))
+    return w
+
+
 def _vpad1(b: np.ndarray, padded: int) -> np.ndarray:
     if b.shape[0] < padded:
         b = np.pad(b, (0, padded - b.shape[0]))

@@ -14,17 +14,11 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..config import InferenceConfig
-from .contrib import _SimpleConfig, _ident, _t, _vpad1
+from .contrib import _SimpleConfig, _ident, _t, _vpad, _vpad1
 from .family import DecoderFamily, register_family
 from .model_base import DecoderSpec, spec_from_config
 from ..modules.moe import MoESpec
 from ..parallel.layers import place_q_weight, replicate_kv_weight
-
-
-def _vpad(w: np.ndarray, padded: int) -> np.ndarray:
-    if w.shape[0] < padded:
-        w = np.pad(w, [(0, padded - w.shape[0])] + [(0, 0)] * (w.ndim - 1))
-    return w
 
 
 # ---------------------------------------------------------------------------
@@ -730,16 +724,6 @@ class HunYuanDenseFamily(DecoderFamily):
             tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
                                              False)),
         )
-
-    @classmethod
-    def convert_extra_layer_weights(cls, get, layer_stack, spec):
-        p = cls.hf_prefix
-        return {
-            "q_norm": layer_stack(
-                p + ".layers.{i}.self_attn.query_layernorm.weight", _ident),
-            "k_norm": layer_stack(
-                p + ".layers.{i}.self_attn.key_layernorm.weight", _ident),
-        }
 
     @classmethod
     def convert_hf_state_dict(cls, sd, spec):

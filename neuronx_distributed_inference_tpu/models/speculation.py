@@ -835,6 +835,10 @@ def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                        jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
     feat = jnp.take_along_axis(
         out["hidden"], best[:, None, None], axis=1)[:, 0]
+    # features along the accepted path (node j = depth j), for EAGLE draft
+    # refresh: slot base+j+1 pairs with the feature of position base+j
+    path_feats = jnp.take_along_axis(
+        out["hidden"], path_slot[:, :, None], axis=1)        # (B, D+1, H)
 
     # cache refresh: linearize [root, accepted..., bonus]
     refresh_toks = jnp.concatenate([root[:, None], tokens], axis=1)
@@ -846,7 +850,8 @@ def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     upd = model_base.token_generation_multi(
         spec, tpu_cfg, params, out["cache"], refresh_toks, rpos, seq_ids)
     return {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,
-            "feature": feat, "cache": upd["cache"]}
+            "feature": feat, "path_features": path_feats,
+            "cache": upd["cache"]}
 
 
 class DynamicTreeDecoder:
@@ -923,3 +928,177 @@ class DynamicTreeDecoder:
                 "sequences": np.concatenate([input_ids, gen], axis=1),
                 "mean_accept": (float(np.mean(np.concatenate(emitted_counts)))
                                 if emitted_counts else 0.0)}
+
+
+# ===========================================================================
+# EAGLE token-tree speculation (reference: the EAGLE token-tree flagship
+# mode, models/model_base.py:2094-2515 — tree proposals come from the EAGLE
+# DRAFT model, the target verifies the tree in one forward; the dynamic
+# lattice (EAGLE-2 style, modules/eagle/dynamic_token_tree.py) selects the
+# tree shape from the draft's own scores)
+# ===========================================================================
+
+def eagle_propose_scored(draft_spec: DecoderSpec, tpu_cfg: TpuConfig,
+                         draft_params, draft_cache, last_token, prev_hidden,
+                         positions, seq_ids, depth: int, top_k: int,
+                         input_norm: bool = False):
+    """Chain-rollout tree proposals from the EAGLE draft: D greedy draft
+    steps; at each depth record the top-k tokens + logprobs of that depth's
+    distribution. Returns (prop_toks (B,D,k), prop_logp (B,D,k), dcache).
+    The rollout's speculative draft-KV writes are overwritten by the
+    post-acceptance refresh (reference: :2663-2694)."""
+
+    def dstep(carry, _):
+        tok, hid, pos, cch = carry
+        out = eagle_forward(draft_spec, tpu_cfg, draft_params, cch,
+                            tok[:, None], hid[:, None, :], pos[:, None],
+                            seq_ids, input_norm)
+        logp = jax.nn.log_softmax(out["logits"][:, -1, :].astype(jnp.float32))
+        top_lp, top_ids = jax.lax.top_k(logp, top_k)
+        ntok = top_ids[:, 0].astype(jnp.int32)
+        return (ntok, out["hidden"][:, -1, :], pos + 1, out["cache"]), \
+            (top_ids.astype(jnp.int32), top_lp)
+
+    (_, _, _, dcache), (toks, lps) = jax.lax.scan(
+        dstep, (last_token, prev_hidden, positions, draft_cache), None,
+        length=depth)
+    return (jnp.transpose(toks, (1, 0, 2)), jnp.transpose(lps, (1, 0, 2)),
+            dcache)
+
+
+def eagle_tree_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
+                    tpu_cfg: TpuConfig, draft_params, target_params,
+                    draft_cache, target_cache, root, prev_hidden, base_pos,
+                    seq_ids, lat_dep, lat_par, lat_br, lat_anc, lat_path,
+                    num_nodes: int, cache_len: int, depth: int,
+                    branch_k: int, input_norm: bool = False):
+    """One fused EAGLE token-tree step: draft chain rollout scores the
+    lattice, the dynamic top-N tree is verified by the target in one
+    forward, and BOTH caches are refreshed with the accepted linear
+    sequence. root (B,) at position base_pos (already emitted);
+    prev_hidden (B,H) = target feature at base_pos-1."""
+    prop_toks, prop_logp, dcache = eagle_propose_scored(
+        draft_spec, tpu_cfg, draft_params, draft_cache, root, prev_hidden,
+        base_pos, seq_ids, depth, branch_k, input_norm)
+    res = dynamic_medusa_tree_step(
+        target_spec, tpu_cfg, target_params, target_cache, root, prop_toks,
+        prop_logp, base_pos, seq_ids, lat_dep, lat_par, lat_br, lat_anc,
+        lat_path, num_nodes=num_nodes, cache_len=cache_len)
+
+    # draft refresh with the VERIFIED pairs: slot base+j <- (token at
+    # base+j, target feature at base+j-1). The rollout's chain writes are
+    # stale wherever the accepted path deviated from the draft's greedy
+    # chain (reference: final draft cache-update run :2663-2694).
+    n_acc = res["num_emitted"] - 1
+    refresh_toks = jnp.concatenate([root[:, None], res["tokens"]], axis=1)
+    # widths agree by construction: 1 root + (depth+1) tokens vs
+    # 1 prev_hidden + (depth+1) path features
+    hid_seq = jnp.concatenate(
+        [prev_hidden[:, None, :], res["path_features"]], axis=1)
+    ridx = jnp.arange(refresh_toks.shape[1], dtype=jnp.int32)[None, :]
+    rpos = base_pos[:, None] + ridx
+    rpos = jnp.where(ridx <= (n_acc + 1)[:, None], rpos,
+                     kv_mod.cache_len_of(dcache))
+    upd = eagle_forward(draft_spec, tpu_cfg, draft_params, dcache,
+                        refresh_toks, hid_seq, rpos, seq_ids, input_norm)
+    return {"tokens": res["tokens"], "num_emitted": res["num_emitted"],
+            "bonus": res["bonus"], "feature": res["feature"],
+            "draft_cache": upd["cache"], "target_cache": res["cache"]}
+
+
+class EagleTreeDecoder:
+    """Host loop for EAGLE token-tree speculation: the EAGLE draft proposes,
+    the dynamic lattice picks the top-N tree, the target verifies it in one
+    forward (reference: model_base.py:2094-2515)."""
+
+    def __init__(self, target_app, draft_spec: DecoderSpec, draft_params,
+                 draft_cache, depth: int = 4, branch_k: int = 4,
+                 num_nodes: int = 16, input_norm: bool = False):
+        self.target = target_app
+        self.draft_spec = draft_spec
+        self.draft_params = draft_params
+        self.draft_cache = draft_cache
+        self.depth = depth
+        self.num_nodes = num_nodes
+        self.branch_k = branch_k
+        cfg = target_app.tpu_config
+        dep, par, br, anc, path = build_lattice(branch_k, depth)
+        if num_nodes > dep.shape[0]:
+            raise ValueError("num_nodes exceeds the candidate lattice")
+        self._lat = tuple(jnp.asarray(x) for x in (dep, par, br, anc, path))
+        self._step = jax.jit(
+            partial(eagle_tree_step, draft_spec, target_app.spec, cfg,
+                    num_nodes=num_nodes, cache_len=cfg.seq_len, depth=depth,
+                    branch_k=branch_k, input_norm=input_norm),
+            donate_argnums=(2, 3))
+        self._prefill = jax.jit(
+            partial(eagle_forward, draft_spec, cfg, input_norm=input_norm),
+            donate_argnums=(1,))
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 128,
+                 eos_token_id: Optional[int] = None) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids).astype(np.int32)
+        b, s = input_ids.shape
+        cfg = self.target.tpu_config
+        if not cfg.output_full_hidden:
+            raise ValueError("target app needs output_full_hidden=True "
+                             "(EAGLE primes the draft from prefill hiddens)")
+        seq_lens = np.full((b,), s, np.int32)
+        seq_ids = np.arange(b, dtype=np.int32)
+        t_out = self.target._run_prefill(input_ids, seq_lens)
+        hs = np.asarray(t_out["hidden_states"])[:, :s]
+        root = np.asarray(t_out["tokens"]).astype(np.int32)
+        if s > 1:
+            d_out = self._prefill(
+                self.draft_params, self.draft_cache,
+                jnp.asarray(input_ids[:, 1:]), jnp.asarray(hs[:, :-1]),
+                jnp.broadcast_to(jnp.arange(1, s, dtype=jnp.int32),
+                                 (b, s - 1)),
+                jnp.asarray(seq_ids))
+            self.draft_cache = d_out["cache"]
+
+        eos_set = (None if eos_token_id is None else
+                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+        out_rows = [[int(root[i])] for i in range(b)]
+        prev_hidden = jnp.asarray(hs[:, -1])
+        positions = seq_lens.copy()
+        done = np.zeros((b,), bool)
+        emitted_counts = []
+        budget = max(self.num_nodes, self.depth) + 2
+        while (min(len(r) for r in out_rows) < max_new_tokens
+               and int(positions.max()) + budget < cfg.seq_len
+               and not done.all()):
+            res = self._step(self.draft_params, self.target.params,
+                             self.draft_cache, self.target.cache,
+                             jnp.asarray(root), prev_hidden,
+                             jnp.asarray(positions), jnp.asarray(seq_ids),
+                             *self._lat)
+            self.draft_cache = res["draft_cache"]
+            self.target.cache = res["target_cache"]
+            toks = np.asarray(res["tokens"])
+            n_emit = np.asarray(res["num_emitted"])
+            emitted_counts.append(n_emit.copy())
+            for i in range(b):
+                if done[i]:
+                    continue
+                for t in toks[i, :n_emit[i]].tolist():
+                    out_rows[i].append(int(t))
+                    if eos_set is not None and int(t) in eos_set:
+                        done[i] = True
+                        break
+            positions = positions + n_emit.astype(np.int32)
+            root = np.asarray(res["bonus"]).astype(np.int32)
+            prev_hidden = res["feature"]
+
+        gen = np.zeros((b, max_new_tokens), np.int32)
+        for i in range(b):
+            row = out_rows[i][:max_new_tokens]
+            gen[i, :len(row)] = row
+            if len(row) < max_new_tokens:
+                gen[i, len(row):] = row[-1]
+        return {
+            "sequences": np.concatenate([input_ids, gen], axis=1),
+            "generated": gen,
+            "mean_tokens_per_step": (float(np.mean(np.concatenate(
+                emitted_counts))) if emitted_counts else 0.0),
+        }

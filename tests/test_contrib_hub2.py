@@ -194,7 +194,7 @@ def test_hunyuan_dense_matches_hf(tmp_path):
                                intermediate_size=128, head_dim=16,
                                vocab_size=256, attention_dropout=0.0,
                                torch_dtype="float32")
-    app = _check(tmp_path, "hunyuan_v1_dense", HunYuanDenseV1Config and
+    app = _check(tmp_path, "hunyuan_v1_dense",
                  HunYuanDenseV1ForCausalLM(cfg))
     assert app.spec.qk_norm and app.spec.qk_norm_after_rope
 
