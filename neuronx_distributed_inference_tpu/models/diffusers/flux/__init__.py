@@ -1,5 +1,7 @@
-from .pipeline import (FluxImg2ImgPipeline, FluxPipeline,
-                       build_random_pipeline, shifted_sigmas)
+from .pipeline import (FluxControlPipeline, FluxFillPipeline,
+                       FluxImg2ImgPipeline, FluxPipeline,
+                       build_random_pipeline, fold_mask_8x8,
+                       shifted_sigmas)
 from .transformer import (FluxSpec, flux_forward, init_flux_params,
                           make_img_ids, pack_latents, unpack_latents)
 from .vae import VaeSpec, init_vae_params, vae_decode

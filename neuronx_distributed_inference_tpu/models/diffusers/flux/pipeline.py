@@ -55,6 +55,28 @@ class FluxPipeline:
         ctx = self._t5(self.t5_params, jnp.asarray(t5_ids))
         return ctx, pooled
 
+    def _sample(self, clip_ids, t5_ids, x, lh, lw, sigmas, start,
+                guidance, decode, known_packed=None, mask_packed=None,
+                noise_packed=None, cond_packed=None) -> Dict[str, Any]:
+        """Shared tail of every pipeline variant: text encode -> denoise
+        from ``start`` -> unpack -> optional VAE decode."""
+        b = clip_ids.shape[0]
+        ctx, pooled = self.encode_text(clip_ids, t5_ids)
+        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
+        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
+        g = jnp.full((b,), guidance, jnp.float32)
+        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas,
+                     start, known_packed, mask_packed, noise_packed,
+                     cond_packed)
+        if mask_packed is not None:
+            # final blend: known region restored exactly
+            x = jnp.where(mask_packed, x, known_packed)
+        lat = ftx.unpack_latents(x, lh, lw)
+        out = {"latents": np.asarray(lat), "sigmas": sigmas}
+        if decode:
+            out["images"] = np.asarray(self._vae(self.vae_params, lat))
+        return out
+
     def __call__(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
                  height: int = 64, width: int = 64, num_steps: int = 4,
                  guidance: float = 3.5, shift: float = 3.0,
@@ -63,36 +85,31 @@ class FluxPipeline:
         (h/8, w/8) with 2x2 packing."""
         b = clip_ids.shape[0]
         lh, lw = height // 8, width // 8
-        ctx, pooled = self.encode_text(clip_ids, t5_ids)
         key = jax.random.PRNGKey(seed)
         lat = jax.random.normal(
             key, (b, self.vae_spec.latent_channels, lh, lw), jnp.float32)
-        x = ftx.pack_latents(lat)
-        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
-        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
-        g = jnp.full((b,), guidance, jnp.float32)
-
         sigmas = shifted_sigmas(num_steps, shift)
-        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas, 0)
-
-        lat = ftx.unpack_latents(x, lh, lw)
-        out = {"latents": np.asarray(lat), "sigmas": sigmas}
-        if decode:
-            img = self._vae(self.vae_params, lat)
-            out["images"] = np.asarray(img)
-        return out
+        return self._sample(clip_ids, t5_ids, ftx.pack_latents(lat), lh, lw,
+                            sigmas, 0, guidance, decode)
 
 
 def _denoise(pipe: "FluxPipeline", x, ctx, pooled, img_ids, txt_ids, g,
              sigmas, start: int,
-             known_packed=None, mask_packed=None, noise_packed=None):
+             known_packed=None, mask_packed=None, noise_packed=None,
+             cond_packed=None):
     """Euler flow-matching loop from step ``start``; optional inpaint
-    blending re-imposes the known region at each step's noise level
-    (reference: diffusers/flux/pipeline.py text2img/control/inpaint)."""
+    blending re-imposes the known region at each step's noise level;
+    ``cond_packed`` (B, T, C_cond) is channel-concatenated onto the model
+    input at EVERY step (Control / Fill conditioning — the transformer's
+    in_channels covers base+cond, its output only the base; reference:
+    diffusers/flux/pipeline.py text2img/control/fill/inpaint via
+    NeuronFluxControlPipeline/NeuronFluxFillPipeline :393-429)."""
     b = x.shape[0]
     for i in range(start, len(sigmas) - 1):
         t = jnp.full((b,), sigmas[i], jnp.float32)
-        v = pipe._flux(pipe.params, x, ctx, t, pooled, img_ids, txt_ids,
+        xin = (x if cond_packed is None
+               else jnp.concatenate([x, cond_packed], axis=-1))
+        v = pipe._flux(pipe.params, xin, ctx, t, pooled, img_ids, txt_ids,
                        guidance=g)
         x = euler_step(x, v, float(sigmas[i]), float(sigmas[i + 1]))
         if mask_packed is not None:
@@ -113,27 +130,18 @@ class FluxImg2ImgPipeline(FluxPipeline):
                 num_steps: int = 4, guidance: float = 3.5,
                 shift: float = 3.0, seed: int = 0,
                 decode: bool = True) -> Dict[str, Any]:
-        b = clip_ids.shape[0]
         lat0 = jnp.asarray(init_latents, jnp.float32)
         lh, lw = lat0.shape[2], lat0.shape[3]
-        ctx, pooled = self.encode_text(clip_ids, t5_ids)
         sigmas = shifted_sigmas(num_steps, shift)
         start = min(int(num_steps * (1.0 - strength)), num_steps - 1)
-        key = jax.random.PRNGKey(seed)
-        noise = jax.random.normal(key, lat0.shape, jnp.float32)
+        noise = jax.random.normal(jax.random.PRNGKey(seed), lat0.shape,
+                                  jnp.float32)
         # flow-matching interpolation to the start noise level
         s0 = float(sigmas[start])
         x = ftx.pack_latents((1.0 - s0) * lat0 + s0 * noise)
-        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
-        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
-        g = jnp.full((b,), guidance, jnp.float32)
-        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas,
-                     start)
-        lat = ftx.unpack_latents(x, lh, lw)
-        out = {"latents": np.asarray(lat), "sigmas": sigmas,
-               "start_step": start}
-        if decode:
-            out["images"] = np.asarray(self._vae(self.vae_params, lat))
+        out = self._sample(clip_ids, t5_ids, x, lh, lw, sigmas, start,
+                           guidance, decode)
+        out["start_step"] = start
         return out
 
     def inpaint(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
@@ -143,32 +151,96 @@ class FluxImg2ImgPipeline(FluxPipeline):
                 decode: bool = True) -> Dict[str, Any]:
         """mask (B, 1, h/8, w/8): True/1 = region to REGENERATE; the known
         region is re-imposed at each step's noise level."""
-        b = clip_ids.shape[0]
         lat0 = jnp.asarray(init_latents, jnp.float32)
         lh, lw = lat0.shape[2], lat0.shape[3]
-        ctx, pooled = self.encode_text(clip_ids, t5_ids)
         sigmas = shifted_sigmas(num_steps, shift)
         start = min(int(num_steps * (1.0 - strength)), num_steps - 1)
-        key = jax.random.PRNGKey(seed)
-        noise = jax.random.normal(key, lat0.shape, jnp.float32)
+        noise = jax.random.normal(jax.random.PRNGKey(seed), lat0.shape,
+                                  jnp.float32)
         s0 = float(sigmas[start])
         x = ftx.pack_latents((1.0 - s0) * lat0 + s0 * noise)
         m = jnp.broadcast_to(jnp.asarray(mask, bool), lat0.shape)
-        mask_packed = ftx.pack_latents(m.astype(jnp.float32)) > 0.5
-        known_packed = ftx.pack_latents(lat0)
-        noise_packed = ftx.pack_latents(noise)
-        img_ids = jnp.asarray(ftx.make_img_ids(b, lh, lw))
-        txt_ids = jnp.zeros((b, t5_ids.shape[1], 3), jnp.int32)
-        g = jnp.full((b,), guidance, jnp.float32)
-        x = _denoise(self, x, ctx, pooled, img_ids, txt_ids, g, sigmas,
-                     start, known_packed, mask_packed, noise_packed)
-        # final blend: known region restored exactly
-        x = jnp.where(mask_packed, x, known_packed)
-        lat = ftx.unpack_latents(x, lh, lw)
-        out = {"latents": np.asarray(lat), "sigmas": sigmas}
-        if decode:
-            out["images"] = np.asarray(self._vae(self.vae_params, lat))
-        return out
+        return self._sample(
+            clip_ids, t5_ids, x, lh, lw, sigmas, start, guidance, decode,
+            known_packed=ftx.pack_latents(lat0),
+            mask_packed=ftx.pack_latents(m.astype(jnp.float32)) > 0.5,
+            noise_packed=ftx.pack_latents(noise))
+
+
+class FluxControlPipeline(FluxPipeline):
+    """Control conditioning (reference: NeuronFluxControlPipeline,
+    diffusers/flux/pipeline.py:420): the VAE-encoded control image's packed
+    latents are channel-concatenated onto the transformer input at every
+    denoise step — spec.in_channels must be 2x the packed latent width,
+    spec.out_channels the base width."""
+
+    def control(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
+                control_latents: np.ndarray, num_steps: int = 4,
+                guidance: float = 3.5, shift: float = 3.0, seed: int = 0,
+                decode: bool = True) -> Dict[str, Any]:
+        """control_latents (B, C, h/8, w/8) — VAE-encoded control image."""
+        cond_lat = jnp.asarray(control_latents, jnp.float32)
+        lh, lw = cond_lat.shape[2], cond_lat.shape[3]
+        cond = ftx.pack_latents(cond_lat)
+        base_ch = cond.shape[-1]
+        out_ch = self.spec.out_channels or self.spec.in_channels
+        if self.spec.in_channels != 2 * base_ch or out_ch != base_ch:
+            raise ValueError(
+                f"control pipeline needs transformer in_channels "
+                f"{2 * base_ch} (= 2x packed latents) and out_channels "
+                f"{base_ch}, got in={self.spec.in_channels} out={out_ch}")
+        x = ftx.pack_latents(jax.random.normal(
+            jax.random.PRNGKey(seed), cond_lat.shape, jnp.float32))
+        sigmas = shifted_sigmas(num_steps, shift)
+        return self._sample(clip_ids, t5_ids, x, lh, lw, sigmas, 0,
+                            guidance, decode, cond_packed=cond)
+
+
+def fold_mask_8x8(mask: np.ndarray) -> np.ndarray:
+    """Pixel-resolution inpaint mask (B, 1, 8*lh, 8*lw) -> 64-channel
+    latent-resolution representation (B, 64, lh, lw): each latent pixel
+    carries its 8x8 pixel-mask patch as channels (reference: diffusers
+    FluxFillPipeline.prepare_mask_latents mask folding)."""
+    m = np.asarray(mask, np.float32)
+    b, one, hp, wp = m.shape
+    lh, lw = hp // 8, wp // 8
+    m = m.reshape(b, lh, 8, lw, 8)
+    return np.ascontiguousarray(
+        m.transpose(0, 2, 4, 1, 3).reshape(b, 64, lh, lw))
+
+
+class FluxFillPipeline(FluxPipeline):
+    """Fill / inpaint-conditioned transformer (reference:
+    NeuronFluxFillPipeline, diffusers/flux/pipeline.py:393): conditioning =
+    packed masked-image latents + the packed 64-channel folded pixel mask,
+    channel-concatenated at every step. With a 16-ch VAE the transformer
+    reads 64 (latents) + 64 (masked image) + 256 (mask) = 384 channels."""
+
+    def fill(self, clip_ids: np.ndarray, t5_ids: np.ndarray,
+             masked_latents: np.ndarray, mask_pixels: np.ndarray,
+             num_steps: int = 4, guidance: float = 30.0, shift: float = 3.0,
+             seed: int = 0, decode: bool = True) -> Dict[str, Any]:
+        """masked_latents (B, C, lh, lw): VAE encoding of image*(1-mask);
+        mask_pixels (B, 1, 8*lh, 8*lw): 1 = region to regenerate."""
+        mlat = jnp.asarray(masked_latents, jnp.float32)
+        lh, lw = mlat.shape[2], mlat.shape[3]
+        cond_img = ftx.pack_latents(mlat)                    # (B, T, 64)
+        mask64 = jnp.asarray(fold_mask_8x8(mask_pixels))
+        cond_mask = ftx.pack_latents(mask64)                 # (B, T, 256)
+        cond = jnp.concatenate([cond_img, cond_mask], axis=-1)
+        base_ch = cond_img.shape[-1]
+        want = base_ch + cond.shape[-1]
+        out_ch = self.spec.out_channels or self.spec.in_channels
+        if self.spec.in_channels != want or out_ch != base_ch:
+            raise ValueError(
+                f"fill pipeline needs transformer in_channels {want} and "
+                f"out_channels {base_ch}, got in={self.spec.in_channels} "
+                f"out={out_ch}")
+        x = ftx.pack_latents(jax.random.normal(
+            jax.random.PRNGKey(seed), mlat.shape, jnp.float32))
+        sigmas = shifted_sigmas(num_steps, shift)
+        return self._sample(clip_ids, t5_ids, x, lh, lw, sigmas, 0,
+                            guidance, decode, cond_packed=cond)
 
 
 def build_random_pipeline(seed: int = 0, tiny: bool = True) -> FluxPipeline:

@@ -33,6 +33,10 @@ class FluxSpec:
     depth_double: int = 19
     depth_single: int = 38
     in_channels: int = 64            # packed 2x2 latent patches (16ch VAE)
+    # velocity output channels; None = in_channels. Control/Fill variants
+    # read concatenated conditioning channels but predict only the base
+    # latents (reference: diffusers FluxControl/Fill transformer geometry)
+    out_channels: Optional[int] = None
     context_dim: int = 4096          # T5 features
     pooled_dim: int = 768            # CLIP pooled
     axes_dim: Tuple[int, int, int] = (16, 56, 56)   # rope split per axis
@@ -102,7 +106,7 @@ def flux_param_specs(spec: FluxSpec) -> Dict[str, Any]:
         "double": stacked(double, spec.depth_double),
         "single": stacked(single, spec.depth_single),
         "final_mod": _linear(H, 2 * H),
-        "final_proj": _linear(H, spec.in_channels),
+        "final_proj": _linear(H, spec.out_channels or spec.in_channels),
     }
     if spec.guidance_embed:
         specs["guidance_in1"] = _linear(256, H)

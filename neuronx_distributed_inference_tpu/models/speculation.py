@@ -318,6 +318,63 @@ def eagle_speculation_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
     }
 
 
+
+def _prime_eagle_draft(decoder, input_ids, hs, seq_ids):
+    """Prime the draft cache over the prompt: slot p <- (token p, feature
+    p-1) (reference: EAGLE CTE, model_base.py:1931-2092)."""
+    b, s = input_ids.shape
+    if s > 1:
+        d_out = decoder._prefill(
+            decoder.draft_params, decoder.draft_cache,
+            jnp.asarray(input_ids[:, 1:]), jnp.asarray(hs[:, :-1]),
+            jnp.broadcast_to(jnp.arange(1, s, dtype=jnp.int32), (b, s - 1)),
+            jnp.asarray(seq_ids))
+        decoder.draft_cache = d_out["cache"]
+
+
+def _eagle_host_loop(input_ids, first, prev_hidden, seq_lens, max_new_tokens,
+                     eos_token_id, seq_len_cap, budget, step_fn):
+    """Shared EAGLE host loop: call ``step_fn(root, prev_hidden, positions)
+    -> (tokens (B,W), n_emit (B,), next_root (B,), next_hidden (B,H))``
+    until every row has max_new_tokens or hit EOS; assemble the padded
+    output (reference: hf_adapter fused decode loop :495)."""
+    b = input_ids.shape[0]
+    eos_set = (None if eos_token_id is None else
+               set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
+    out_rows = [[int(first[i])] for i in range(b)]
+    root = first
+    positions = seq_lens.copy()
+    done = np.zeros((b,), bool)
+    emitted_counts = []
+    while (min(len(r) for r in out_rows) < max_new_tokens
+           and int(positions.max()) + budget < seq_len_cap
+           and not done.all()):
+        toks, n_emit, root, prev_hidden = step_fn(root, prev_hidden,
+                                                  positions)
+        emitted_counts.append(n_emit.copy())
+        for i in range(b):
+            if done[i]:
+                continue
+            for t in toks[i, :n_emit[i]].tolist():
+                out_rows[i].append(int(t))
+                if eos_set is not None and int(t) in eos_set:
+                    done[i] = True
+                    break
+        positions = positions + n_emit.astype(np.int32)
+    gen = np.zeros((b, max_new_tokens), np.int32)
+    for i in range(b):
+        row = out_rows[i][:max_new_tokens]
+        gen[i, :len(row)] = row
+        if len(row) < max_new_tokens:
+            gen[i, len(row):] = row[-1]
+    return {
+        "sequences": np.concatenate([input_ids, gen], axis=1),
+        "generated": gen,
+        "mean_tokens_per_step": (float(np.mean(np.concatenate(
+            emitted_counts))) if emitted_counts else 0.0),
+    }
+
+
 class EagleDecoder:
     """Host orchestration for fused EAGLE speculation. The per-(seq, position)
     hidden-state rolling buffer of the reference (modules/eagle/
@@ -358,61 +415,23 @@ class EagleDecoder:
         t_out = self.target._run_prefill(input_ids, seq_lens)
         hs = np.asarray(t_out["hidden_states"])[:, :s]       # (B,S,H)
         first = np.asarray(t_out["tokens"]).astype(np.int32)
+        _prime_eagle_draft(self, input_ids, hs, seq_ids)
 
-        # prime the draft cache over the prompt: slot p <- (token p, feat p-1)
-        if s > 1:
-            d_out = self._prefill(
-                self.draft_params, self.draft_cache,
-                jnp.asarray(input_ids[:, 1:]), jnp.asarray(hs[:, :-1]),
-                jnp.broadcast_to(jnp.arange(1, s, dtype=jnp.int32), (b, s - 1)),
-                jnp.asarray(seq_ids))
-            self.draft_cache = d_out["cache"]
-
-        eos_set = (None if eos_token_id is None else
-                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
-        out_rows = [[int(first[i])] for i in range(b)]
-        last = first
-        prev_hidden = jnp.asarray(hs[:, -1])
-        positions = seq_lens.copy()
-        done = np.zeros((b,), bool)
-        emitted_counts = []
-        max_total = cfg.seq_len
-        while (min(len(r) for r in out_rows) < max_new_tokens
-               and int(positions.max()) + self.k + 1 < max_total
-               and not done.all()):
+        def step_fn(root, prev_hidden, positions):
             res = self._step(self.draft_params, self.target.params,
                              self.draft_cache, self.target.cache,
-                             jnp.asarray(last), prev_hidden,
+                             jnp.asarray(root), prev_hidden,
                              jnp.asarray(positions), jnp.asarray(seq_ids))
             self.draft_cache = res["draft_cache"]
             self.target.cache = res["target_cache"]
-            toks = np.asarray(res["tokens"])
-            n_emit = np.asarray(res["num_emitted"])
-            emitted_counts.append(n_emit.copy())
-            for i in range(b):
-                if done[i]:
-                    continue
-                for t in toks[i, :n_emit[i]].tolist():
-                    out_rows[i].append(int(t))
-                    if eos_set is not None and int(t) in eos_set:
-                        done[i] = True
-                        break
-            positions = positions + n_emit.astype(np.int32)
-            last = np.asarray(res["next_token"]).astype(np.int32)
-            prev_hidden = res["next_hidden"]
+            return (np.asarray(res["tokens"]),
+                    np.asarray(res["num_emitted"]),
+                    np.asarray(res["next_token"]).astype(np.int32),
+                    res["next_hidden"])
 
-        gen = np.zeros((b, max_new_tokens), np.int32)
-        for i in range(b):
-            row = out_rows[i][:max_new_tokens]
-            gen[i, :len(row)] = row
-            if len(row) < max_new_tokens:
-                gen[i, len(row):] = row[-1]
-        return {
-            "sequences": np.concatenate([input_ids, gen], axis=1),
-            "generated": gen,
-            "mean_tokens_per_step": (float(np.mean(np.concatenate(
-                emitted_counts))) if emitted_counts else 0.0),
-        }
+        return _eagle_host_loop(input_ids, first, jnp.asarray(hs[:, -1]),
+                                seq_lens, max_new_tokens, eos_token_id,
+                                cfg.seq_len, self.k + 1, step_fn)
 
 
 # ===========================================================================
@@ -776,7 +795,8 @@ def dynamic_tree_select(lat, prop_logp, num_nodes: int):
 def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                              cache, root, prop_toks, prop_logp, base_pos,
                              seq_ids, lat_dep, lat_par, lat_br, lat_anc,
-                             lat_path, num_nodes: int, cache_len: int):
+                             lat_path, num_nodes: int, cache_len: int,
+                             return_path_features: bool = False):
     """One dynamic-tree verify step: build the tree in-graph from the
     proposal scores, verify, accept the deepest fully-matching path.
     root (B,) last emitted token; prop_toks/prop_logp (B, D, k)."""
@@ -835,10 +855,6 @@ def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                        jnp.where(idx == n_acc[:, None], bonus[:, None], 0))
     feat = jnp.take_along_axis(
         out["hidden"], best[:, None, None], axis=1)[:, 0]
-    # features along the accepted path (node j = depth j), for EAGLE draft
-    # refresh: slot base+j+1 pairs with the feature of position base+j
-    path_feats = jnp.take_along_axis(
-        out["hidden"], path_slot[:, :, None], axis=1)        # (B, D+1, H)
 
     # cache refresh: linearize [root, accepted..., bonus]
     refresh_toks = jnp.concatenate([root[:, None], tokens], axis=1)
@@ -849,9 +865,15 @@ def dynamic_medusa_tree_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                      kv_mod.cache_len_of(out["cache"]))
     upd = model_base.token_generation_multi(
         spec, tpu_cfg, params, out["cache"], refresh_toks, rpos, seq_ids)
-    return {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,
-            "feature": feat, "path_features": path_feats,
-            "cache": upd["cache"]}
+    res = {"tokens": tokens, "num_emitted": n_acc + 1, "bonus": bonus,
+           "feature": feat, "cache": upd["cache"]}
+    if return_path_features:
+        # features along the accepted path (node j = depth j), for the
+        # EAGLE draft refresh: slot base+j+1 pairs with the feature of
+        # position base+j — only the EAGLE tree step pays for this gather
+        res["path_features"] = jnp.take_along_axis(
+            out["hidden"], path_slot[:, :, None], axis=1)    # (B, D+1, H)
+    return res
 
 
 class DynamicTreeDecoder:
@@ -983,7 +1005,8 @@ def eagle_tree_step(draft_spec: DecoderSpec, target_spec: DecoderSpec,
     res = dynamic_medusa_tree_step(
         target_spec, tpu_cfg, target_params, target_cache, root, prop_toks,
         prop_logp, base_pos, seq_ids, lat_dep, lat_par, lat_br, lat_anc,
-        lat_path, num_nodes=num_nodes, cache_len=cache_len)
+        lat_path, num_nodes=num_nodes, cache_len=cache_len,
+        return_path_features=True)
 
     # draft refresh with the VERIFIED pairs: slot base+j <- (token at
     # base+j, target feature at base+j-1). The rollout's chain writes are
@@ -1047,27 +1070,10 @@ class EagleTreeDecoder:
         seq_ids = np.arange(b, dtype=np.int32)
         t_out = self.target._run_prefill(input_ids, seq_lens)
         hs = np.asarray(t_out["hidden_states"])[:, :s]
-        root = np.asarray(t_out["tokens"]).astype(np.int32)
-        if s > 1:
-            d_out = self._prefill(
-                self.draft_params, self.draft_cache,
-                jnp.asarray(input_ids[:, 1:]), jnp.asarray(hs[:, :-1]),
-                jnp.broadcast_to(jnp.arange(1, s, dtype=jnp.int32),
-                                 (b, s - 1)),
-                jnp.asarray(seq_ids))
-            self.draft_cache = d_out["cache"]
+        first = np.asarray(t_out["tokens"]).astype(np.int32)
+        _prime_eagle_draft(self, input_ids, hs, seq_ids)
 
-        eos_set = (None if eos_token_id is None else
-                   set(np.atleast_1d(np.asarray(eos_token_id)).tolist()))
-        out_rows = [[int(root[i])] for i in range(b)]
-        prev_hidden = jnp.asarray(hs[:, -1])
-        positions = seq_lens.copy()
-        done = np.zeros((b,), bool)
-        emitted_counts = []
-        budget = max(self.num_nodes, self.depth) + 2
-        while (min(len(r) for r in out_rows) < max_new_tokens
-               and int(positions.max()) + budget < cfg.seq_len
-               and not done.all()):
+        def step_fn(root, prev_hidden, positions):
             res = self._step(self.draft_params, self.target.params,
                              self.draft_cache, self.target.cache,
                              jnp.asarray(root), prev_hidden,
@@ -1075,30 +1081,12 @@ class EagleTreeDecoder:
                              *self._lat)
             self.draft_cache = res["draft_cache"]
             self.target.cache = res["target_cache"]
-            toks = np.asarray(res["tokens"])
-            n_emit = np.asarray(res["num_emitted"])
-            emitted_counts.append(n_emit.copy())
-            for i in range(b):
-                if done[i]:
-                    continue
-                for t in toks[i, :n_emit[i]].tolist():
-                    out_rows[i].append(int(t))
-                    if eos_set is not None and int(t) in eos_set:
-                        done[i] = True
-                        break
-            positions = positions + n_emit.astype(np.int32)
-            root = np.asarray(res["bonus"]).astype(np.int32)
-            prev_hidden = res["feature"]
+            return (np.asarray(res["tokens"]),
+                    np.asarray(res["num_emitted"]),
+                    np.asarray(res["bonus"]).astype(np.int32),
+                    res["feature"])
 
-        gen = np.zeros((b, max_new_tokens), np.int32)
-        for i in range(b):
-            row = out_rows[i][:max_new_tokens]
-            gen[i, :len(row)] = row
-            if len(row) < max_new_tokens:
-                gen[i, len(row):] = row[-1]
-        return {
-            "sequences": np.concatenate([input_ids, gen], axis=1),
-            "generated": gen,
-            "mean_tokens_per_step": (float(np.mean(np.concatenate(
-                emitted_counts))) if emitted_counts else 0.0),
-        }
+        budget = max(self.num_nodes, self.depth) + 2
+        return _eagle_host_loop(input_ids, first, jnp.asarray(hs[:, -1]),
+                                seq_lens, max_new_tokens, eos_token_id,
+                                cfg.seq_len, budget, step_fn)

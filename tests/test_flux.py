@@ -190,3 +190,83 @@ def test_flux_tp4_matches_single_device(rng):
         got = np.asarray(_jax.jit(partial(ftx.flux_forward, spec))(
             sharded, x, ctx, t, pooled, img_ids, txt_ids, guidance=g))
     np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_flux_control_pipeline(tiny_pipe, rng):
+    """FLUX Control: control latents channel-concatenated at every step,
+    transformer in_channels = 2x packed width (reference:
+    NeuronFluxControlPipeline, diffusers/flux/pipeline.py:420)."""
+    import dataclasses
+    import jax
+    from neuronx_distributed_inference_tpu.models.diffusers.flux import (
+        FluxControlPipeline, FluxSpec, init_flux_params)
+    spec = dataclasses.replace(tiny_pipe.spec, in_channels=128,
+                               out_channels=64)
+    fields = {f.name: getattr(tiny_pipe, f.name)
+              for f in dataclasses.fields(tiny_pipe)}
+    fields["spec"] = spec
+    fields["params"] = init_flux_params(spec, jax.random.PRNGKey(5))
+    pipe = FluxControlPipeline(**fields)
+    clip_ids = rng.integers(3, 100, size=(1, 8)).astype(np.int32)
+    t5_ids = rng.integers(3, 100, size=(1, 12)).astype(np.int32)
+    ctrl = rng.standard_normal((1, 16, 4, 4)).astype(np.float32)
+    out = pipe.control(clip_ids, t5_ids, ctrl, num_steps=2)
+    assert out["latents"].shape == (1, 16, 4, 4)
+    assert np.isfinite(out["images"]).all()
+    # the control image actually conditions the result
+    out2 = pipe.control(clip_ids, t5_ids, ctrl * -1.0, num_steps=2,
+                        decode=False)
+    assert not np.allclose(out["latents"], out2["latents"])
+    # deterministic
+    out3 = pipe.control(clip_ids, t5_ids, ctrl, num_steps=2, decode=False)
+    np.testing.assert_array_equal(out["latents"], out3["latents"])
+    # base pipeline geometry is rejected loudly
+    with pytest.raises(ValueError):
+        FluxControlPipeline(**{**fields, "spec": tiny_pipe.spec,
+                               "params": tiny_pipe.params}).control(
+            clip_ids, t5_ids, ctrl, num_steps=1)
+
+
+def test_flux_fill_pipeline(tiny_pipe, rng):
+    """FLUX Fill: masked-image latents + folded 8x8 pixel mask as 320
+    conditioning channels (reference: NeuronFluxFillPipeline,
+    diffusers/flux/pipeline.py:393)."""
+    import dataclasses
+    import jax
+    from neuronx_distributed_inference_tpu.models.diffusers.flux import (
+        FluxFillPipeline, fold_mask_8x8, init_flux_params)
+    spec = dataclasses.replace(tiny_pipe.spec, in_channels=64 + 64 + 256,
+                               out_channels=64)
+    fields = {f.name: getattr(tiny_pipe, f.name)
+              for f in dataclasses.fields(tiny_pipe)}
+    fields["spec"] = spec
+    fields["params"] = init_flux_params(spec, jax.random.PRNGKey(6))
+    pipe = FluxFillPipeline(**fields)
+    clip_ids = rng.integers(3, 100, size=(1, 8)).astype(np.int32)
+    t5_ids = rng.integers(3, 100, size=(1, 12)).astype(np.int32)
+    masked = rng.standard_normal((1, 16, 4, 4)).astype(np.float32)
+    mask = np.zeros((1, 1, 32, 32), np.float32)
+    mask[:, :, 8:24, 8:24] = 1.0
+    out = pipe.fill(clip_ids, t5_ids, masked, mask, num_steps=2)
+    assert out["latents"].shape == (1, 16, 4, 4)
+    assert np.isfinite(out["images"]).all()
+    # mask conditioning changes the result
+    out2 = pipe.fill(clip_ids, t5_ids, masked, np.ones_like(mask),
+                     num_steps=2, decode=False)
+    assert not np.allclose(out["latents"], out2["latents"])
+
+
+def test_fold_mask_8x8_semantics(rng):
+    """Each latent pixel's 64 channels = its 8x8 pixel-mask patch
+    (reference: diffusers FluxFillPipeline.prepare_mask_latents)."""
+    from neuronx_distributed_inference_tpu.models.diffusers.flux import \
+        fold_mask_8x8
+    m = rng.standard_normal((2, 1, 16, 24)).astype(np.float32)
+    out = fold_mask_8x8(m)
+    assert out.shape == (2, 64, 2, 3)
+    for bi in range(2):
+        for li in range(2):
+            for lj in range(3):
+                patch = m[bi, 0, li * 8:(li + 1) * 8, lj * 8:(lj + 1) * 8]
+                np.testing.assert_array_equal(out[bi, :, li, lj],
+                                              patch.reshape(64))
