@@ -249,6 +249,17 @@ class TpuConfig:
     context_encoding_buckets: Optional[List[int]] = None
     token_generation_buckets: Optional[List[int]] = None
     bucket_n_active_tokens: bool = False
+    # 2-D bucketing (reference: autobucketing.py:22-64,203 — batch x seq
+    # TKG buckets + prefix x prefill buckets; selection
+    # model_wrapper.py:923-1045): short batches pad to the smallest BATCH
+    # bucket instead of the full compiled batch, and the paged app sizes
+    # its block-table width from a ladder instead of max_blocks.
+    # Tradeoff: a sub-cache-batch decode graph takes the row-gather paths
+    # instead of the identity fast path / fused decode kernel — worth it
+    # when pad rows dominate (large batch, small requests), not for
+    # window/sink models that lean on the kernel; hence default OFF
+    enable_2d_bucketing: bool = False
+    tkg_batch_buckets: Optional[List[int]] = None   # explicit batch ladder
 
     # --- sampling ---
     on_device_sampling_config: Optional[OnDeviceSamplingConfig] = None

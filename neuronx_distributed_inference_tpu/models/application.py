@@ -68,6 +68,9 @@ class CausalLMApplication:
         self._rng = jax.random.PRNGKey(self.tpu_config.seed)
         self.ctx_buckets = autobucketing.context_encoding_buckets(self.tpu_config)
         self.tkg_buckets = autobucketing.token_generation_buckets(self.tpu_config)
+        # 2-D bucketing: allowed compiled batch sizes (reference: batch x
+        # seq TKG buckets, autobucketing.py:203)
+        self.batch_buckets = autobucketing.batch_buckets(self.tpu_config)
         # observability (reference: utils/snapshot.py env-driven capture;
         # utils/tensor_replacement/ golden injection)
         from ..utils.snapshot import SnapshotManager
@@ -274,14 +277,24 @@ class CausalLMApplication:
         starts = [1] if len(self.tkg_buckets) <= 1 else [
             max(b - chunk, 1) for b in self.tkg_buckets]
         for start in starts:
-            if chunk > 1:
-                self._run_decode_loop(np.zeros((bt,), np.int32),
-                                      np.full((bt,), start, np.int32), chunk)
-            # the chunk tail of generate() uses the single-step graph —
-            # warm it per bucket too, or the first request reaching a new
-            # bucket stalls on a mid-request compile
-            self._run_decode(np.zeros((bt, 1), np.int32),
-                             np.full((bt, 1), start, np.int32))
+            for bb in self.batch_buckets:     # 2-D: every batch bucket
+                if chunk > 1:
+                    self._run_decode_loop(np.zeros((bb,), np.int32),
+                                          np.full((bb,), start, np.int32),
+                                          chunk)
+                # the chunk tail of generate() uses the single-step graph —
+                # warm it per bucket too, or the first request reaching a
+                # new bucket stalls on a mid-request compile
+                self._run_decode(np.zeros((bb, 1), np.int32),
+                                 np.full((bb, 1), start, np.int32))
+        # 2-D batch buckets: warm each short-batch prefill at the smallest
+        # ctx bucket (the remaining grid compiles lazily; the decode loop —
+        # the stall that matters mid-request — is warmed above)
+        for bb in self.batch_buckets:
+            if bb != b:
+                self._run_prefill(np.zeros((bb, self.ctx_buckets[0]),
+                                           np.int32),
+                                  np.ones((bb,), np.int32))
         return self
 
     # ------------------------------------------------------------------
@@ -471,7 +484,8 @@ class CausalLMApplication:
                     for si in range(n_steps)]
             return merged
 
-        pad = cfg.batch_size - b_in
+        pad = autobucketing.get_target_bucket(self.batch_buckets,
+                                              b_in) - b_in
 
         def _pad0(k, x):
             if not _batchful(k, x):
@@ -525,11 +539,13 @@ class CausalLMApplication:
         models/model_base.py:566-578). Decode advances all axes by 1/token."""
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
-        if b != self.tpu_config.batch_size:
+        if b not in self.batch_buckets:
             # serving host shim (reference: model_wrapper.py:520-703
             # repeat-first-batchline pad + :1315-1440 sub-batching): pad a
-            # short batch to the batch bucket by repeating row 0, or split
-            # an oversized batch into compiled-batch chunks
+            # short batch to the smallest BATCH bucket by repeating row 0
+            # (2-D bucketing: the ladder may hold sizes below the full
+            # compiled batch), or split an oversized batch into
+            # compiled-batch chunks
             return self._generate_repadded(
                 input_ids, attention_mask=attention_mask,
                 max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
@@ -827,6 +843,11 @@ class PagedCausalLMApplication(CausalLMApplication):
         self.kv_mgr.cache = None
         # static block-table width for the jitted graphs
         self.max_blocks = bspec.blocks_for(cfg.seq_len)
+        # 2-D prefix x prefill bucketing: per-call block-table widths
+        # (reference: autobucketing.py:22-64, selection
+        # model_wrapper.py:923-1045)
+        self._bt_buckets = autobucketing.block_table_buckets(
+            cfg, self.max_blocks)
         return self
 
     def _jit_paged(self):
@@ -862,6 +883,14 @@ class PagedCausalLMApplication(CausalLMApplication):
             return self._compiled[key]
         return super().get_compiled(tag, bucket)
 
+    def _bt_width(self, b: int) -> int:
+        """Smallest block-table width bucket covering every live row's
+        blocks (2-D prefix x prefill bucket selection)."""
+        live = max((len(self.kv_mgr.tables.get(i, ())) for i in range(b)),
+                   default=1)
+        return autobucketing.get_target_bucket(self._bt_buckets,
+                                               max(live, 1))
+
     def _run_paged(self, input_ids, position_ids, slot_mapping, block_table,
                    last_idx, sampling_params=None):
         fn = self.get_compiled("paged_forward")
@@ -894,6 +923,13 @@ class PagedCausalLMApplication(CausalLMApplication):
             self._run_paged(np.zeros((b, w), np.int32),
                             np.zeros((b, w), np.int32),
                             np.full((b, w), -1, np.int32), bt,
+                            np.zeros((b,), np.int32))
+        # 2-D table-width buckets: warm the decode step at every width
+        for tw in self._bt_buckets[:-1]:
+            self._run_paged(np.zeros((b, 1), np.int32),
+                            np.zeros((b, 1), np.int32),
+                            np.full((b, 1), -1, np.int32),
+                            np.zeros((b, tw), np.int32),
                             np.zeros((b,), np.int32))
         return self
 
@@ -947,7 +983,7 @@ class PagedCausalLMApplication(CausalLMApplication):
             batch_fresh.update(blocks[c // bsz:])
             # always recompute >= 1 token so there are logits to sample from
             cached[i] = min(c, seq_lens[i] - 1)
-        bt = self.kv_mgr.block_table_array(range(b), self.max_blocks)
+        bt = self.kv_mgr.block_table_array(range(b), self._bt_width(b))
 
         # --- prefill the uncached suffixes ---
         suffix_lens = seq_lens - cached
@@ -991,7 +1027,10 @@ class PagedCausalLMApplication(CausalLMApplication):
                 tokens[final_here, 0] = toks[final_here]
                 off = off + chunk_w
         else:
-            bucket = autobucketing.get_target_bucket(self.ctx_buckets, t_max)
+            # joint (prefill width x table width) selection (reference: 2-D
+            # prefix-caching bucket selection, model_wrapper.py:923-1045)
+            bucket, _tw = autobucketing.get_target_bucket_2d(
+                self.ctx_buckets, self._bt_buckets, t_max, bt.shape[1])
             out = _prefill_window(np.zeros((b,), np.int32), bucket,
                                   np.maximum(suffix_lens - 1, 0))
             tokens = np.asarray(out["tokens"]).reshape(b, 1)
@@ -1024,7 +1063,7 @@ class PagedCausalLMApplication(CausalLMApplication):
                 break
             for i in range(b):
                 self.kv_mgr.grow(i, steps)
-            bt = self.kv_mgr.block_table_array(range(b), self.max_blocks)
+            bt = self.kv_mgr.block_table_array(range(b), self._bt_width(b))
             cur = collected[-1][:, -1].astype(np.int32)
             if steps == 1:
                 pos = positions[:, None]

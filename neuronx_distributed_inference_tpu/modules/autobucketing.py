@@ -55,3 +55,38 @@ def get_target_bucket(buckets: List[int], length: int) -> int:
             return b
     raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
 
+
+def batch_buckets(tpu_config) -> List[int]:
+    """TKG batch-bucket ladder (reference: 2-D batch x seq TKG buckets,
+    autobucketing.py:203): with 2-D bucketing a short batch pads to the
+    smallest BATCH bucket instead of the full compiled batch — fewer pad
+    rows, at the cost of extra compiled graphs. 1-D mode keeps the single
+    full-batch bucket."""
+    if not (tpu_config.enable_bucketing and tpu_config.enable_2d_bucketing):
+        return [tpu_config.batch_size]
+    if tpu_config.tkg_batch_buckets:
+        out = sorted(set(tpu_config.tkg_batch_buckets))
+        if out[-1] != tpu_config.batch_size:
+            raise ValueError("tkg_batch_buckets must end at batch_size")
+        return out
+    return generate_buckets(1, tpu_config.batch_size)
+
+
+def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:
+    """Paged-app block-table width ladder (reference: 2-D prefix x prefill
+    buckets, autobucketing.py:22-64 + selection model_wrapper.py:923-1045):
+    each paged call sizes its table to the smallest bucket covering the
+    live blocks instead of always max_blocks — the attention gather /
+    ragged kernel grid shrink with it."""
+    if not (tpu_config.enable_bucketing and tpu_config.enable_2d_bucketing):
+        return [max_blocks]
+    return generate_buckets(1, max_blocks)
+
+
+def get_target_bucket_2d(row_buckets: List[int], col_buckets: List[int],
+                         rows: int, cols: int) -> tuple:
+    """Smallest covering (row, col) bucket pair (reference: 2-D bucket
+    selection, model_wrapper.py:923-1045)."""
+    return (get_target_bucket(row_buckets, rows),
+            get_target_bucket(col_buckets, cols))
+

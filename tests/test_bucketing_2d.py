@@ -1,0 +1,103 @@
+"""2-D bucketing (reference: modules/autobucketing.py:22-64,203 batch x seq
+TKG + prefix x prefill buckets; selection model_wrapper.py:923-1045):
+bucket-selection units + generate() exercising a non-trivial 2-D grid."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.application import (
+    CausalLMApplication, PagedCausalLMApplication)
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules import autobucketing as ab
+
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5, rope_theta=10000.0,
+          hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+
+def test_batch_bucket_ladder():
+    cfg = TpuConfig(batch_size=8, seq_len=64, enable_bucketing=True,
+                    enable_2d_bucketing=True)
+    assert ab.batch_buckets(cfg) == [1, 2, 4, 8]
+    cfg1 = TpuConfig(batch_size=8, seq_len=64, enable_bucketing=True)
+    assert ab.batch_buckets(cfg1) == [8]
+    cfg2 = TpuConfig(batch_size=8, seq_len=64, enable_bucketing=True,
+                     enable_2d_bucketing=True, tkg_batch_buckets=[2, 8])
+    assert ab.batch_buckets(cfg2) == [2, 8]
+    with pytest.raises(ValueError):
+        ab.batch_buckets(TpuConfig(batch_size=8, seq_len=64,
+                                   enable_bucketing=True,
+                                   enable_2d_bucketing=True,
+                                   tkg_batch_buckets=[2, 4]))
+
+
+def test_2d_target_selection():
+    bb, sb = ab.get_target_bucket_2d([1, 2, 4, 8], [128, 256, 512], 3, 200)
+    assert (bb, sb) == (4, 256)
+    with pytest.raises(ValueError):
+        ab.get_target_bucket_2d([1, 2], [128], 3, 100)
+
+
+def test_block_table_bucket_ladder():
+    cfg = TpuConfig(batch_size=2, seq_len=64, enable_bucketing=True,
+                    enable_2d_bucketing=True, is_block_kv_layout=True,
+                    pa_block_size=8)
+    assert ab.block_table_buckets(cfg, 16) == [1, 2, 4, 8, 16]
+    cfg1 = TpuConfig(batch_size=2, seq_len=64, enable_bucketing=True,
+                     is_block_kv_layout=True, pa_block_size=8)
+    assert ab.block_table_buckets(cfg1, 16) == [16]
+
+
+def _app(two_d: bool, batch=4):
+    tcfg = TpuConfig(batch_size=batch, seq_len=64, dtype="float32",
+                     enable_bucketing=True, enable_2d_bucketing=two_d,
+                     context_encoding_buckets=[16, 32],
+                     decode_chunk_tokens=4)
+    app = CausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
+                              LlamaFamily)
+    app.init_random_weights(11).init_cache()
+    return app
+
+def test_2d_batch_buckets_generate_matches_full_pad():
+    """A 3-row request on a batch-8... batch-4 app: 2-D mode pads to the
+    batch-4 bucket; output must equal the 1-D full-pad path."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 500, size=(3, 9), dtype=np.int64)
+    want = _app(two_d=False).generate(ids, max_new_tokens=10)
+    app2 = _app(two_d=True)
+    assert app2.batch_buckets == [1, 2, 4]
+    got = app2.generate(ids, max_new_tokens=10)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    # b=1 hits the smallest bucket directly (no padding)
+    got1 = app2.generate(ids[:1], max_new_tokens=10)
+    np.testing.assert_array_equal(got1["generated"], want["generated"][:1])
+
+
+def test_paged_2d_table_width_matches_full():
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 500, size=(2, 11), dtype=np.int64)
+
+    def paged_app(two_d):
+        tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                         enable_bucketing=True, enable_2d_bucketing=two_d,
+                         is_block_kv_layout=True, pa_block_size=8)
+        app = PagedCausalLMApplication(
+            None, LlamaInferenceConfig(tcfg, **HF), LlamaFamily)
+        app.init_random_weights(11).init_cache()
+        return app
+
+    a1 = paged_app(False)
+    want = a1.generate(ids, max_new_tokens=10)
+    a2 = paged_app(True)
+    assert a2._bt_buckets == [1, 2, 4, 8]
+    got = a2.generate(ids, max_new_tokens=10)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+    # the short request ran with a narrow table: 11 prompt + 10 new = 21
+    # tokens -> 3 blocks -> width bucket 4, not max_blocks 8
+    assert a2._bt_width(2) == 4
+    a1.release(); a2.release()
