@@ -190,8 +190,16 @@ class CausalLMApplication:
             v_head_dim=(self.spec.v_head_dim
                         if self.spec.v_head_dim != self.spec.head_dim else None),
         )
-        self.cache = init_cache(spec, self.mesh,
-                                flash_decoding=self.spec.flash_decoding)
+        if self.spec.mixed_kv:
+            # per-layer cache sizes: local layers roll at W (reference:
+            # gpt-oss per-layer KV, gpt_oss_kv_cache_manager.py)
+            from ..modules.kv_cache import init_mixed_cache
+            self.cache = init_mixed_cache(
+                spec, self.spec.layer_pattern, self.spec.sliding_window,
+                self.mesh)
+        else:
+            self.cache = init_cache(spec, self.mesh,
+                                    flash_decoding=self.spec.flash_decoding)
         return self
 
     # ------------------------------------------------------------------
@@ -270,14 +278,15 @@ class CausalLMApplication:
         for s in self.ctx_buckets:
             self._run_prefill(np.zeros((b, s), np.int32),
                               np.zeros((b,), np.int32) + 1)
-        bt = cfg.tkg_batch_size
         chunk = max(cfg.decode_chunk_tokens, 1)
         # compile every TKG seq bucket (reference: warmup runs every bucket
         # of every submodel, application_base.py:349-373)
         starts = [1] if len(self.tkg_buckets) <= 1 else [
             max(b - chunk, 1) for b in self.tkg_buckets]
+        warm_batches = sorted(set(self.batch_buckets)
+                              | {cfg.tkg_batch_size or cfg.batch_size})
         for start in starts:
-            for bb in self.batch_buckets:     # 2-D: every batch bucket
+            for bb in warm_batches:           # 2-D: every batch bucket
                 if chunk > 1:
                     self._run_decode_loop(np.zeros((bb,), np.int32),
                                           np.full((bb,), start, np.int32),
@@ -886,7 +895,10 @@ class PagedCausalLMApplication(CausalLMApplication):
     def _bt_width(self, b: int) -> int:
         """Smallest block-table width bucket covering every live row's
         blocks (2-D prefix x prefill bucket selection)."""
-        live = max((len(self.kv_mgr.tables.get(i, ())) for i in range(b)),
+        return self._bt_width_for(range(b))
+
+    def _bt_width_for(self, seq_ids) -> int:
+        live = max((len(self.kv_mgr.tables.get(i, ())) for i in seq_ids),
                    default=1)
         return autobucketing.get_target_bucket(self._bt_buckets,
                                                max(live, 1))
@@ -924,13 +936,23 @@ class PagedCausalLMApplication(CausalLMApplication):
                             np.zeros((b, w), np.int32),
                             np.full((b, w), -1, np.int32), bt,
                             np.zeros((b,), np.int32))
-        # 2-D table-width buckets: warm the decode step at every width
+        # 2-D table-width buckets: warm every (prefill width x table
+        # width) pair plus the chunked decode loop at every width — the
+        # shapes generate() actually runs
+        chunk = max(cfg.decode_chunk_tokens, 1)
         for tw in self._bt_buckets[:-1]:
-            self._run_paged(np.zeros((b, 1), np.int32),
-                            np.zeros((b, 1), np.int32),
-                            np.full((b, 1), -1, np.int32),
-                            np.zeros((b, tw), np.int32),
-                            np.zeros((b,), np.int32))
+            bt_n = np.zeros((b, tw), np.int32)
+            for w in sorted(widths):
+                self._run_paged(np.zeros((b, w), np.int32),
+                                np.zeros((b, w), np.int32),
+                                np.full((b, w), -1, np.int32), bt_n,
+                                np.zeros((b,), np.int32))
+            if chunk > 1:
+                self._run_paged_loop(np.zeros((b,), np.int32),
+                                     np.zeros((b,), np.int32), bt_n, chunk)
+        if chunk > 1:
+            self._run_paged_loop(np.zeros((b,), np.int32),
+                                 np.zeros((b,), np.int32), bt, chunk)
         return self
 
     def generate(self, input_ids: np.ndarray,
@@ -948,6 +970,15 @@ class PagedCausalLMApplication(CausalLMApplication):
         logits_trace: List[np.ndarray] = []
         input_ids = np.asarray(input_ids)
         b, s = input_ids.shape
+        if b not in self.batch_buckets:
+            # batch-mismatch host shim (reference: model_wrapper.py:520-703
+            # + sub-batching :1315-1440) — without it a b != compiled-batch
+            # request would silently jit a fresh graph mid-request
+            return self._generate_repadded(
+                input_ids, attention_mask=attention_mask,
+                max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+                sampling_params=sampling_params,
+                return_logits=return_logits)
         if attention_mask is None:
             attention_mask = np.ones_like(input_ids)
         seq_lens = attention_mask.astype(np.int32).sum(axis=1)
@@ -1027,10 +1058,11 @@ class PagedCausalLMApplication(CausalLMApplication):
                 tokens[final_here, 0] = toks[final_here]
                 off = off + chunk_w
         else:
-            # joint (prefill width x table width) selection (reference: 2-D
-            # prefix-caching bucket selection, model_wrapper.py:923-1045)
-            bucket, _tw = autobucketing.get_target_bucket_2d(
-                self.ctx_buckets, self._bt_buckets, t_max, bt.shape[1])
+            # 2-D (prefill width x table width) selection: the table width
+            # was already bucketed when bt was built (_bt_width) — this
+            # picks the other axis (reference: 2-D prefix-caching bucket
+            # selection, model_wrapper.py:923-1045)
+            bucket = autobucketing.get_target_bucket(self.ctx_buckets, t_max)
             out = _prefill_window(np.zeros((b,), np.int32), bucket,
                                   np.maximum(suffix_lens - 1, 0))
             tokens = np.asarray(out["tokens"]).reshape(b, 1)

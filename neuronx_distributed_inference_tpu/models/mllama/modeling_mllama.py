@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils.host_loop import greedy_host_loop
+
 from ...config import InferenceConfig, TpuConfig
 from ...modules.kv_cache import KVCacheSpec, cache_len_of, init_cache
 from ...ops import attention as attn_ops
@@ -427,30 +429,31 @@ class MllamaApplication:
 
         # decode: the new token reuses the LAST text row's cross mask (HF
         # extends the mask with the final row during generation)
-        dec_mask = cross_attention_mask[:, -1:, :]
-        positions = seq_lens.astype(np.int32)
+        dec_mask = jnp.asarray(cross_attention_mask[:, -1:, :])
         eos_ids = (None if eos_token_id is None
                    else np.atleast_1d(np.asarray(eos_token_id)))
-        for _ in range(max_new_tokens - 1):
+        state = {"pos": seq_lens.astype(np.int32)}
+        rows = jnp.arange(b, dtype=jnp.int32)
+
+        def step(last):
             self._rng, k1 = jax.random.split(self._rng)
             o = self._step("decode")(
-                self.params, self.cache, cross_kv,
-                jnp.asarray(tokens[-1][:, -1:].astype(np.int32)),
-                jnp.asarray(positions[:, None]),
-                jnp.arange(b, dtype=jnp.int32), None,
-                jnp.asarray(dec_mask), None, k1)
+                self.params, self.cache, cross_kv, last[:, None],
+                jnp.asarray(state["pos"][:, None]), rows, None, dec_mask,
+                None, k1)
             self.cache = o["cache"]
-            tokens.append(np.asarray(o["tokens"]).reshape(b, 1))
+            state["pos"] = state["pos"] + 1
             if "logits" in o:
-                logits.append(np.asarray(o["logits"]))
-            positions = positions + 1
-            if eos_ids is not None and np.isin(tokens[-1], eos_ids).all():
-                break
-        gen = np.concatenate(tokens, axis=1)
+                logits.append(o["logits"])   # device array; fetched below
+            return o["tokens"].reshape(b).astype(jnp.int32)
+
+        # shared chunked host loop (utils/host_loop.py): no per-token fetch
+        first = jnp.asarray(tokens[0].reshape(b).astype(np.int32))
+        gen = greedy_host_loop(step, first, max_new_tokens, eos_ids=eos_ids)
         res = {"sequences": np.concatenate([input_ids, gen], axis=1),
                "generated": gen}
         if logits:
-            res["logits"] = logits
+            res["logits"] = [np.asarray(lg) for lg in logits]
         return res
 
     def reset(self):

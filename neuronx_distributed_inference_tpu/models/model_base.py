@@ -133,6 +133,15 @@ class DecoderSpec:
     # the cache holds only ``sliding_window`` slots, written pos %% w with a
     # position-mapping decode mask — cache bytes scale with w, not seq_len
     rolling_window: bool = False
+    # MIXED per-layer cache sizes (reference: gpt-oss per-layer KV,
+    # modules/kvcache/gpt_oss_kv_cache_manager.py + the per-layer
+    # cache-size map of kv_cache_manager.py): with an alternating
+    # local/global layer_pattern, local layers get ROLLING window-sized
+    # cache rows (W slots) while global layers keep full-seq rows —
+    # roughly halving decode KV bytes for gpt-oss-shaped stacks. The cache
+    # pytree then carries {"k","v"} (global layers) + {"k_l","v_l"}
+    # (local layers); decode selects per layer statically (unrolled).
+    mixed_kv: bool = False
     # llama4 attention variations (reference: models/llama4/
     # modeling_llama4_text.py — chunked attention + NoPE layers):
     # local layers use CHUNKED attention (block-diagonal causal over
@@ -613,7 +622,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 mlp_kind: Optional[str] = None,
                 adapter_ids=None, replace=None, kv_view: int = None,
                 deepstack=None, deepstack_mask=None, prefill_lens=None,
-                side=None):
+                side=None, mixed_local=None):
     """One transformer layer. hidden (B,T,H); k/v_full: the FULL stacked
     cache (L,B,S,Hkv,D) — or, in the paged layout, (L,N_blocks,Bs,Hkv,D)
     with ``slot_mapping``/``block_table`` set (phase "paged", reference:
@@ -654,7 +663,15 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         if spec.capture and name in spec.capture:
             caps[name] = val
         return val
-    if "cos_l" in ai:
+    if mixed_local is not None:
+        # mixed per-layer cache (gpt-oss): the local/global choice is
+        # STATIC per unrolled layer — the local mask is rolling-shaped (W
+        # slots) and cannot be where-selected against the global one
+        if mixed_local:
+            cos, sin, mask = ai["cos_l"], ai["sin_l"], ai["mask_l"]
+        else:
+            cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
+    elif "cos_l" in ai:
         cos = jnp.where(is_local, ai["cos_l"], ai["cos"])
         sin = jnp.where(is_local, ai["sin_l"], ai["sin"])
         mask = jnp.where(is_local, ai["mask_l"], ai["mask"])
@@ -820,7 +837,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             # once per chunk
             pending = (k, v)
         else:
-            roll_w = k_full.shape[4] if spec.rolling_window else 0
+            roll_w = (k_full.shape[4]
+                      if (spec.rolling_window or mixed_local) else 0)
             k_full = kv.write_tokens_at_layer(
                 k_full, kv.quantize_kv(k, k_full.dtype, spec.kv_scale),
                 li, seq_ids, positions, window=roll_w, k_transposed=True)
@@ -828,6 +846,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 v_full, kv.quantize_kv(v, v_full.dtype, spec.kv_scale),
                 li, seq_ids, positions, window=roll_w)
         use_kernel = (side is None
+                      and not mixed_local
                       and spec.decode_kernel is not False
                       and decode_attention.supports(spec, hidden.shape[1])
                       and not spec.rolling_window
@@ -1200,6 +1219,66 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
     return hidden, kf, vf, caps
 
 
+def run_layers_mixed_decode(spec: DecoderSpec, params, cache, hidden, ai,
+                            seq_ids, positions, kv_view=None,
+                            adapter_ids=None):
+    """Decode layer loop over the MIXED cache (reference: gpt-oss per-layer
+    KV sizes, modules/kvcache/gpt_oss_kv_cache_manager.py): local layers
+    read/write the rolling {"k_l","v_l"} stacks (W slots), global layers
+    the full {"k","v"} stacks — selected statically per unrolled layer."""
+    lmap = kv.mixed_layer_map(spec.layer_pattern)
+    kf, vf = cache["k"], cache["v"]
+    kl, vl = cache["k_l"], cache["v_l"]
+    caps_list = []
+    for i in range(spec.num_layers):
+        layer_w = jax.tree.map(lambda a: a[i], params["layers"])
+        loc = bool(spec.layer_pattern[i])
+        if loc:
+            hidden, kl, vl, caps_i = _layer_body(
+                spec, hidden, layer_w, kl, vl, lmap[i], ai,
+                jnp.asarray(True), seq_ids, positions, "decode",
+                identity_seq_ids=True, adapter_ids=adapter_ids,
+                mixed_local=True)
+        else:
+            hidden, kf, vf, caps_i = _layer_body(
+                spec, hidden, layer_w, kf, vf, lmap[i], ai,
+                jnp.asarray(False), seq_ids, positions, "decode",
+                identity_seq_ids=True, adapter_ids=adapter_ids,
+                kv_view=kv_view, mixed_local=False)
+        caps_list.append(caps_i)
+    caps = ({k2: jnp.stack([c[k2] for c in caps_list])
+             for k2 in caps_list[0]} if caps_list and caps_list[0] else {})
+    return hidden, {"k": kf, "v": vf, "k_l": kl, "v_l": vl}, caps
+
+
+def fold_mixed_prefill(spec: DecoderSpec, scratch_cache, cache, seq_lens):
+    """Mixed-cache prefill epilogue: copy the scratch full-length rows of
+    GLOBAL layers into the persistent full stacks and FOLD local layers'
+    rows into the rolling stacks (reference: gpt-oss manager CTE path)."""
+    pat = spec.layer_pattern
+    g_idx = [i for i, x in enumerate(pat) if not x]
+    l_idx = [i for i, x in enumerate(pat) if x]
+    gi = jnp.asarray(g_idx, jnp.int32)
+    li = jnp.asarray(l_idx, jnp.int32)
+    W = cache["k_l"].shape[4]
+    new = dict(cache)
+    new["k"] = jax.lax.dynamic_update_slice(
+        cache["k"], scratch_cache["k"][gi], (0, 0, 0, 0, 0))
+    new["v"] = jax.lax.dynamic_update_slice(
+        cache["v"], scratch_cache["v"][gi], (0, 0, 0, 0, 0))
+    # partial-batch prefill (2-D batch buckets): update rows [0, b) in
+    # place — replacing the stacks would change the cache pytree shape
+    new["k_l"] = jax.lax.dynamic_update_slice(
+        cache["k_l"], kv.fold_rolling_prefill(
+            scratch_cache["k"][li], seq_lens, W, k_transposed=True),
+        (0, 0, 0, 0, 0))
+    new["v_l"] = jax.lax.dynamic_update_slice(
+        cache["v_l"], kv.fold_rolling_prefill(
+            scratch_cache["v"][li], seq_lens, W),
+        (0, 0, 0, 0, 0))
+    return new
+
+
 # ---------------------------------------------------------------------------
 # Step graphs
 # ---------------------------------------------------------------------------
@@ -1273,12 +1352,26 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         deepstack_embeds = jnp.pad(
             deepstack_embeds.astype(hidden.dtype),
             ((0, pad_l), (0, 0), (0, 0), (0, 0)))
+    persistent = cache
+    if spec.mixed_kv:
+        # mixed per-layer cache: prefill runs on a full-length SCRATCH for
+        # every layer; the epilogue folds local layers into the rolling
+        # stacks (reference: gpt_oss_kv_cache_manager.py CTE path)
+        b, sb = input_ids.shape
+        g = spec.gqa
+        kdt = cache["k"].dtype
+        cache = {"k": jnp.zeros((spec.num_layers, b, g.num_kv_heads,
+                                 spec.head_dim, sb), kdt),
+                 "v": jnp.zeros((spec.num_layers, b, g.num_kv_heads, sb,
+                                 spec.v_head_dim), kdt)}
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "prefill",
         identity_seq_ids=not tpu_cfg.is_continuous_batching,
         arange_positions=True, adapter_ids=adapter_ids,
         replacements=replacements, deepstack=deepstack_embeds,
         deepstack_mask=image_mask, prefill_lens=seq_lens)
+    if spec.mixed_kv:
+        new_cache = fold_mixed_prefill(spec, new_cache, persistent, seq_lens)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -1329,10 +1422,20 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                              position_ids, cache_len, window=w, chunk=c),
                          rope_positions=rope_position_ids)
     hidden = _embed(spec, params, input_ids, position_ids)
-    hidden, new_cache, caps = run_layers(
-        spec, params, cache, hidden, ai, seq_ids, position_ids, "decode",
-        identity_seq_ids=not tpu_cfg.is_continuous_batching,
-        adapter_ids=adapter_ids, replacements=replacements, kv_view=kv_view)
+    if spec.mixed_kv:
+        # local layers' rolling stacks: slot != position, rolling mask
+        # (reference: gpt-oss per-layer KV decode)
+        ai["mask_l"] = attn_ops.rolling_decode_mask(
+            position_ids, cache["k_l"].shape[4])
+        hidden, new_cache, caps = run_layers_mixed_decode(
+            spec, params, cache, hidden, ai, seq_ids, position_ids,
+            kv_view=kv_view, adapter_ids=adapter_ids)
+    else:
+        hidden, new_cache, caps = run_layers(
+            spec, params, cache, hidden, ai, seq_ids, position_ids,
+            "decode", identity_seq_ids=not tpu_cfg.is_continuous_batching,
+            adapter_ids=adapter_ids, replacements=replacements,
+            kv_view=kv_view)
     logits = _lm_head(spec, params, hidden)
     out = {"cache": new_cache}
     if caps:
@@ -1351,6 +1454,10 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
     scoring all candidate tokens, model_base.py:2617-2642). Within-step
     causality falls out of the cache-write-then-attend order plus the
     position mask."""
+    if spec.mixed_kv:
+        raise NotImplementedError(
+            "multi-token decode over the mixed per-layer cache is not "
+            "supported; disable speculation or set mixed_kv=False")
     cache_len = kv.cache_len_of(cache)
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
         position_ids, cache_len, window=w, chunk=c))
@@ -1681,6 +1788,22 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         elif roll and not worth_it:
             roll = False
         kw["rolling_window"] = bool(roll)
+    if "mixed_kv" not in kw:
+        # per-layer cache sizes for alternating local/global stacks
+        # (reference: gpt_oss_kv_cache_manager.py): local layers roll at W
+        sc = tcfg.speculation_config
+        kw["mixed_kv"] = bool(
+            kw.get("layer_pattern") is not None
+            and kw.get("sliding_window", 0) > 0
+            and kw.get("attn_chunk", 0) == 0
+            and tcfg.seq_len > kw["sliding_window"]
+            and not tcfg.is_block_kv_layout
+            and not tcfg.flash_decoding_enabled
+            and not tcfg.is_continuous_batching
+            and not (sc and (sc.speculation_length
+                             or sc.medusa_speculation_length))
+            and not (tcfg.tensor_capture_config
+                     or tcfg.tensor_replacement_config))
     if not kw.get("vocab_parallel", True) and tp > 1:
         # older saved configs carry vocab_parallel=false from when the knob
         # was inert; honoring it replicates the (V, H) table on every device

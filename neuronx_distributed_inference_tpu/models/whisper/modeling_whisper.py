@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils.host_loop import greedy_host_loop
+
 from ...config import InferenceConfig, TpuConfig
 from ...ops.normalization import layer_norm
 
@@ -329,26 +331,25 @@ class WhisperApplication:
         t0 = toks.shape[1]
         pos = np.broadcast_to(np.arange(t0, dtype=np.int32), (b, t0))
         with jax.sharding.set_mesh(self.mesh):
-                out = self._step(self.params, cache, cross, jnp.asarray(toks),
-                         jnp.asarray(pos))
-        cache = out["cache"]
-        cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),
-                         np.int32)
-        generated = [cur[:, None]]
-        done = cur == self.spec.eos_token_id
-        for i in range(1, max_new_tokens):
-            p = np.full((b, 1), t0 + i - 1, np.int32)
+            out = self._step(self.params, cache, cross, jnp.asarray(toks),
+                             jnp.asarray(pos))
+        state = {"cache": out["cache"], "pos": t0}
+        first = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+
+        def step(last):
+            p = jnp.full((b, 1), state["pos"], jnp.int32)
             with jax.sharding.set_mesh(self.mesh):
-                out = self._step(self.params, cache, cross,
-                             jnp.asarray(generated[-1][:, -1:]), jnp.asarray(p))
-            cache = out["cache"]
-            cur = np.asarray(jnp.argmax(out["logits"][:, -1], axis=-1),
-                             np.int32)
-            generated.append(cur[:, None])
-            done |= cur == self.spec.eos_token_id
-            if done.all():
-                break
-        gen = np.concatenate(generated, axis=1)
+                o = self._step(self.params, state["cache"], cross,
+                               last[:, None], p)
+            state["cache"] = o["cache"]
+            state["pos"] += 1
+            return jnp.argmax(o["logits"][:, -1], axis=-1).astype(jnp.int32)
+
+        # shared chunked host loop (utils/host_loop.py): tokens stay on
+        # device, EOS checked at chunk boundaries — no per-token fetch
+        gen = greedy_host_loop(
+            step, first, max_new_tokens,
+            eos_ids=np.asarray([self.spec.eos_token_id]))
         return {"sequences": np.concatenate([toks, gen], axis=1),
                 "generated": gen, "encoder_states": np.asarray(enc)}
 

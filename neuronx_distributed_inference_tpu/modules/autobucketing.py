@@ -62,14 +62,15 @@ def batch_buckets(tpu_config) -> List[int]:
     smallest BATCH bucket instead of the full compiled batch — fewer pad
     rows, at the cost of extra compiled graphs. 1-D mode keeps the single
     full-batch bucket."""
+    full = tpu_config.batch_size
     if not (tpu_config.enable_bucketing and tpu_config.enable_2d_bucketing):
-        return [tpu_config.batch_size]
+        return [full]
     if tpu_config.tkg_batch_buckets:
         out = sorted(set(tpu_config.tkg_batch_buckets))
-        if out[-1] != tpu_config.batch_size:
+        if out[-1] != full:
             raise ValueError("tkg_batch_buckets must end at batch_size")
         return out
-    return generate_buckets(1, tpu_config.batch_size)
+    return generate_buckets(1, full)
 
 
 def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:
@@ -82,11 +83,4 @@ def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:
         return [max_blocks]
     return generate_buckets(1, max_blocks)
 
-
-def get_target_bucket_2d(row_buckets: List[int], col_buckets: List[int],
-                         rows: int, cols: int) -> tuple:
-    """Smallest covering (row, col) bucket pair (reference: 2-D bucket
-    selection, model_wrapper.py:923-1045)."""
-    return (get_target_bucket(row_buckets, rows),
-            get_target_bucket(col_buckets, cols))
 

@@ -119,6 +119,67 @@ def init_cache(spec: KVCacheSpec, mesh: Optional[Mesh] = None,
             "v": zeros(spec.v_shape, v_pspec(flash_decoding))}
 
 
+def init_mixed_cache(spec: KVCacheSpec, layer_pattern, window: int,
+                     mesh: Optional[Mesh] = None):
+    """MIXED per-layer cache sizes (reference: gpt-oss per-layer KV,
+    modules/kvcache/gpt_oss_kv_cache_manager.py): global layers get
+    full-seq rows in {"k","v"}; LOCAL layers (layer_pattern[i] True) get
+    ROLLING window-sized rows in {"k_l","v_l"} — decode KV bytes scale
+    with W on local layers instead of seq_len."""
+    import dataclasses
+    n_local = sum(bool(x) for x in layer_pattern)
+    n_global = spec.num_layers - n_local
+    g_spec = dataclasses.replace(spec, num_layers=max(n_global, 1))
+    l_spec = dataclasses.replace(spec, num_layers=max(n_local, 1),
+                                 window=window)
+    cache = init_cache(g_spec, mesh)
+    local = init_cache(l_spec, mesh)
+    cache["k_l"] = local["k"]
+    cache["v_l"] = local["v"]
+    return cache
+
+
+def mixed_layer_map(layer_pattern):
+    """Absolute layer index -> index within its own (local/global) stack."""
+    idx = []
+    n_l = n_g = 0
+    for is_local in layer_pattern:
+        if is_local:
+            idx.append(n_l)
+            n_l += 1
+        else:
+            idx.append(n_g)
+            n_g += 1
+    return idx
+
+
+def fold_rolling_prefill(scratch: jnp.ndarray, seq_lens: jnp.ndarray,
+                         window: int, k_transposed: bool = False
+                         ) -> jnp.ndarray:
+    """Convert a full-length prefill scratch cache (L', B, H, D, S)/(...,
+    S, D) into the rolling layout (W slots, slot j holds the LATEST
+    position p <= seq_len-1 with p % W == j; unwritten slots zero) —
+    the mixed-cache prefill epilogue (reference: gpt-oss manager CTE
+    write path)."""
+    s_axis = 4 if k_transposed else 3
+    last = seq_lens.astype(jnp.int32) - 1                       # (B,)
+    j = jnp.arange(window, dtype=jnp.int32)                     # (W,)
+    q = last[:, None] - ((last[:, None] - j[None, :]) % window)  # (B, W)
+    valid = q >= 0
+    qc = jnp.clip(q, 0, scratch.shape[s_axis] - 1)
+    if k_transposed:
+        idx = qc[None, :, None, None, :]                        # (1,B,1,1,W)
+        gathered = jnp.take_along_axis(
+            scratch, jnp.broadcast_to(
+                idx, scratch.shape[:4] + (window,)), axis=4)
+        return jnp.where(valid[None, :, None, None, :], gathered, 0)
+    idx = qc[None, :, None, :, None]                            # (1,B,1,W,1)
+    gathered = jnp.take_along_axis(
+        scratch, jnp.broadcast_to(
+            idx, scratch.shape[:3] + (window, scratch.shape[4])), axis=3)
+    return jnp.where(valid[None, :, None, :, None], gathered, 0)
+
+
 def quantize_kv(x: jnp.ndarray, dtype, scale: Optional[float] = None) -> jnp.ndarray:
     """KV quantization on write (reference: kv_cache_manager.py:636-692):
     direct-cast mode (scale=None) or scaled mode — store x/scale so the fp8

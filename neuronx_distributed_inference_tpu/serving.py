@@ -1,0 +1,220 @@
+"""Serving integration surface — the importable continuous-batching
+contract a vLLM-style engine drives (reference: the vLLM-facing surface of
+models/model_wrapper.py — ``vllm_cte_repadding`` :1297-1313 and the
+seq_ids-addressed forward :1315-1440; the reference README's north star is
+serving through vLLM).
+
+The engine owns scheduling; this adapter owns device state:
+
+  * ``add_requests(seq_ids, prompts)``  — prefill rows into their cache
+    lines (cache rows are addressed BY seq_id, so request order is free)
+  * ``step(seq_ids=None)``              — one decode step for the given
+    (default: all) running rows, repadded to the compiled batch bucket
+  * ``release(seq_ids)``                — free rows (and paged blocks)
+
+Works over either application:
+  - ``CausalLMApplication`` with ``is_continuous_batching=True`` —
+    contiguous cache rows keyed by seq_id;
+  - ``PagedCausalLMApplication`` — block tables keyed by seq_id.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .modules import autobucketing
+
+
+@dataclass
+class _SeqState:
+    position: int                 # position of last_token
+    last_token: int
+    running: bool = True
+
+
+class ContinuousBatchingAdapter:
+    """vLLM-style engine adapter over the contiguous app
+    (reference: model_wrapper.py:1297-1440)."""
+
+    def __init__(self, app):
+        cfg = app.tpu_config
+        if not cfg.is_continuous_batching:
+            raise ValueError("app must be built with "
+                             "is_continuous_batching=True")
+        self.app = app
+        self.batch = cfg.batch_size
+        self.seqs: Dict[int, _SeqState] = {}
+
+    # -- capacity ---------------------------------------------------------
+    @property
+    def free_slots(self) -> List[int]:
+        used = set(self.seqs)
+        return [i for i in range(self.batch) if i not in used]
+
+    # -- lifecycle --------------------------------------------------------
+    def add_requests(self, seq_ids: Sequence[int],
+                     prompts: Sequence[Sequence[int]]) -> Dict[int, int]:
+        """Prefill ``prompts`` into cache rows ``seq_ids``. Returns
+        {seq_id: first generated token}. Rows are padded to the ctx bucket
+        (repeat-row-0 batch pad — reference ``vllm_cte_repadding``)."""
+        if len(seq_ids) != len(prompts):
+            raise ValueError("seq_ids and prompts length mismatch")
+        for sid in seq_ids:
+            if not 0 <= sid < self.batch:
+                raise ValueError(f"seq_id {sid} out of range [0,{self.batch})")
+            if sid in self.seqs:
+                raise ValueError(f"seq_id {sid} already running")
+        b = len(seq_ids)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        width = autobucketing.get_target_bucket(self.app.ctx_buckets,
+                                                int(lens.max()))
+        ids = np.zeros((b, width), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+        pad_to = self._batch_bucket(b)
+        ids_p, sid_p = self._pad_rows(ids, np.asarray(seq_ids, np.int32),
+                                      pad_to)
+        lens_p = np.concatenate([lens, np.repeat(lens[:1], pad_to - b)])
+        out = self.app._run_prefill(ids_p, lens_p, seq_ids=sid_p)
+        toks = np.asarray(out["tokens"])[:b]
+        res = {}
+        for i, sid in enumerate(seq_ids):
+            self.seqs[sid] = _SeqState(position=int(lens[i]),
+                                       last_token=int(toks[i]))
+            res[sid] = int(toks[i])
+        return res
+
+    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
+        """One decode step for ``seq_ids`` (default: every running row).
+        Returns {seq_id: next token}."""
+        live = [sid for sid in (seq_ids if seq_ids is not None
+                                else sorted(self.seqs))
+                if self.seqs[sid].running]
+        if not live:
+            return {}
+        b = len(live)
+        pad_to = self._batch_bucket(b)
+        sid = np.asarray(live, np.int32)
+        toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
+        pos = np.asarray([self.seqs[s].position for s in live], np.int32)
+        sid_p = np.concatenate([sid, np.repeat(sid[:1], pad_to - b)])
+        toks_p = np.concatenate([toks, np.repeat(toks[:1], pad_to - b)])
+        pos_p = np.concatenate([pos, np.repeat(pos[:1], pad_to - b)])
+        out = self.app._run_decode(toks_p[:, None], pos_p[:, None],
+                                   seq_ids=sid_p)
+        new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        res = {}
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            st.position += 1
+            st.last_token = int(new[i])
+            res[s] = int(new[i])
+        return res
+
+    def release(self, seq_ids: Sequence[int]):
+        for sid in seq_ids:
+            self.seqs.pop(sid, None)
+
+    # -- helpers ----------------------------------------------------------
+    def _batch_bucket(self, b: int) -> int:
+        if b > self.batch:
+            raise ValueError(f"live batch {b} exceeds compiled batch "
+                             f"{self.batch}")
+        return autobucketing.get_target_bucket(self.app.batch_buckets, b)
+
+    @staticmethod
+    def _pad_rows(ids: np.ndarray, seq_ids: np.ndarray, pad_to: int):
+        pad = pad_to - ids.shape[0]
+        if pad <= 0:
+            return ids, seq_ids
+        return (np.concatenate([ids, np.repeat(ids[:1], pad, axis=0)]),
+                np.concatenate([seq_ids, np.repeat(seq_ids[:1], pad)]))
+
+
+class PagedEngineAdapter:
+    """vLLM-style engine adapter over the PAGED app: block tables keyed by
+    seq_id, slot mappings computed from the tables (reference: the
+    slot_mapping / active_block_table contract of
+    block_kv_cache_manager.py + model_wrapper.py:1297-1313)."""
+
+    def __init__(self, app):
+        cfg = app.tpu_config
+        if not cfg.is_block_kv_layout:
+            raise ValueError("app must be built with is_block_kv_layout=True")
+        self.app = app
+        self.batch = cfg.batch_size
+        self.seqs: Dict[int, _SeqState] = {}
+
+    def add_requests(self, seq_ids: Sequence[int],
+                     prompts: Sequence[Sequence[int]]) -> Dict[int, int]:
+        from .modules.block_kv_cache import slots_from_table
+        if len(seq_ids) != len(prompts):
+            raise ValueError("seq_ids and prompts length mismatch")
+        app = self.app
+        b = len(seq_ids)
+        lens = np.asarray([len(p) for p in prompts], np.int32)
+        cached = np.zeros((b,), np.int32)
+        for i, sid in enumerate(seq_ids):
+            if sid in self.seqs:
+                raise ValueError(f"seq_id {sid} already running")
+            _, c = app.kv_mgr.begin_sequence(sid, list(prompts[i]))
+            cached[i] = min(c, lens[i] - 1)
+        width = autobucketing.get_target_bucket(
+            app.ctx_buckets, int((lens - cached).max()))
+        bt = app.kv_mgr.block_table_array(seq_ids, app._bt_width_for(seq_ids))
+        ids_w = np.zeros((b, width), np.int32)
+        pos_w = np.zeros((b, width), np.int32)
+        for i, p in enumerate(prompts):
+            lo = int(cached[i])
+            n = int(lens[i] - lo)
+            ids_w[i, :n] = np.asarray(p[lo:lo + n])
+            pos_w[i] = lo + np.arange(width, dtype=np.int32)
+        valid = np.arange(width)[None, :] < (lens - cached)[:, None]
+        slots = slots_from_table(bt, np.where(valid, pos_w, -1),
+                                 app.kv_mgr.spec.block_size)
+        out = app._run_paged(ids_w, pos_w, slots, bt,
+                             np.maximum(lens - cached - 1, 0))
+        toks = np.asarray(out["tokens"]).reshape(-1)
+        res = {}
+        for i, sid in enumerate(seq_ids):
+            self.seqs[sid] = _SeqState(position=int(lens[i]),
+                                       last_token=int(toks[i]))
+            res[sid] = int(toks[i])
+        return res
+
+    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
+        from .modules.block_kv_cache import slots_from_table
+        app = self.app
+        live = [sid for sid in (seq_ids if seq_ids is not None
+                                else sorted(self.seqs))
+                if self.seqs[sid].running]
+        if not live:
+            return {}
+        b = len(live)
+        toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
+        pos = np.asarray([self.seqs[s].position for s in live], np.int32)
+        for s in live:
+            app.kv_mgr.grow(s, 1)
+        bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
+        slots = slots_from_table(bt, pos[:, None],
+                                 app.kv_mgr.spec.block_size)
+        out = app._run_paged(toks[:, None], pos[:, None], slots, bt,
+                             np.zeros((b,), np.int32))
+        new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        res = {}
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            st.position += 1
+            st.last_token = int(new[i])
+            res[s] = int(new[i])
+        return res
+
+    def release(self, seq_ids: Sequence[int]):
+        for sid in seq_ids:
+            if sid in self.seqs:
+                self.seqs.pop(sid)
+                if sid in self.app.kv_mgr.tables:
+                    self.app.kv_mgr.end_sequence(sid)

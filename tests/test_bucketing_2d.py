@@ -37,10 +37,11 @@ def test_batch_bucket_ladder():
 
 
 def test_2d_target_selection():
-    bb, sb = ab.get_target_bucket_2d([1, 2, 4, 8], [128, 256, 512], 3, 200)
-    assert (bb, sb) == (4, 256)
+    # the two axes select independently via get_target_bucket
+    assert ab.get_target_bucket([1, 2, 4, 8], 3) == 4
+    assert ab.get_target_bucket([128, 256, 512], 200) == 256
     with pytest.raises(ValueError):
-        ab.get_target_bucket_2d([1, 2], [128], 3, 100)
+        ab.get_target_bucket([1, 2], 3)
 
 
 def test_block_table_bucket_ladder():

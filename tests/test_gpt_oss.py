@@ -114,3 +114,36 @@ def test_oai_mxfp4_blocks_roundtrip(rng):
     import jax.numpy as jnp
     ours = np.asarray(dequantize(leaf, jnp.float32)).T   # (rows, K)
     np.testing.assert_allclose(deq, ours, rtol=1e-6)
+
+
+def test_mixed_per_layer_kv_cache_halves_bytes(tmp_path):
+    """Mixed per-layer cache sizes (reference: gpt-oss per-layer KV,
+    modules/kvcache/gpt_oss_kv_cache_manager.py): local layers' rows roll
+    at W slots; generation must match the full-cache path exactly."""
+    import dataclasses
+    import jax
+    d, _ = _save_tiny_gpt_oss(tmp_path)
+
+    def app_for(mixed):
+        app = _build_app(d, output_logits=False)
+        if not mixed:
+            app.spec = dataclasses.replace(app.spec, mixed_kv=False)
+            app.init_cache()
+        return app
+
+    a_full = app_for(mixed=False)
+    a_mix = app_for(mixed=True)
+    assert a_mix.spec.mixed_kv and not a_full.spec.mixed_kv
+    bytes_full = sum(x.size * x.dtype.itemsize
+                     for x in jax.tree.leaves(a_full.cache))
+    bytes_mix = sum(x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(a_mix.cache))
+    # half the layers are local at W=8 of seq 48: ~42% smaller here
+    assert bytes_mix < 0.62 * bytes_full, (bytes_mix, bytes_full)
+
+    rng = np.random.default_rng(3)
+    ids = rng.integers(1, 250, size=(2, 11)).astype(np.int64)
+    mask = np.ones_like(ids); mask[1, 9:] = 0; ids[1, 9:] = 0
+    want = a_full.generate(ids, attention_mask=mask, max_new_tokens=12)
+    got = a_mix.generate(ids, attention_mask=mask, max_new_tokens=12)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
