@@ -205,6 +205,12 @@ class TpuConfig:
     is_continuous_batching: bool = False
     seq_len: int = 128                        # max total sequence length
     max_context_length: Optional[int] = None  # max prefill length
+    # windowed context encoding (reference: models/model_base.py:878-933 +
+    # the >=32k long-context mode, models/config.py:612-621): prompts are
+    # prefilled in fixed windows re-invoking one graph with growing KV —
+    # the (S, S) prefill attention materialization becomes (W, S), which
+    # is what makes >=32k contexts feasible. None = one-shot prefill.
+    windowed_context_encoding: Optional[int] = None
     n_active_tokens: int = 1
     n_positions: Optional[int] = None
 

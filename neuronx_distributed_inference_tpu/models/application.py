@@ -247,6 +247,10 @@ class CausalLMApplication:
                 steps, kv_bucket = bucket if isinstance(bucket, tuple) \
                     else (bucket, None)
                 self._compiled[key] = self._jit_decode_loop(steps, kv_bucket)
+            elif tag == "windowed_cte":
+                fn = partial(model_base.token_generation_multi, self.spec,
+                             self.tpu_config)
+                self._compiled[key] = jax.jit(fn, donate_argnums=(1,))
             else:
                 raise KeyError(tag)
         return self._compiled[key]
@@ -366,6 +370,62 @@ class CausalLMApplication:
                      rope_position_ids, deepstack_embeds)
         self.cache = out["cache"]
         return out
+
+    def _run_prefill_windowed(self, input_ids: np.ndarray,
+                              seq_lens: np.ndarray, window: int,
+                              sampling_params=None):
+        """Windowed context encoding (reference: models/model_base.py:878-933
+        + long-context mode, models/config.py:612-621): walk the prompt in
+        fixed windows re-invoking ONE decode-phase multi-token graph with
+        growing KV — the (S, S) one-shot prefill attention materialization
+        becomes (W, S), which is what makes >=32k contexts feasible.
+        Returns {"tokens", "cache"} like _run_prefill."""
+        b, s = input_ids.shape
+        if self.spec.rolling_window or self.spec.mixed_kv:
+            # the windowed-CTE graph addresses cache slot == position; a
+            # rolling cache stores slot = pos % W - silently wrong reads
+            raise NotImplementedError(
+                "windowed_context_encoding is incompatible with rolling / "
+                "mixed per-layer KV caches (slot != position)")
+        seq_ids = jnp.arange(b, dtype=jnp.int32)
+        fn = self.get_compiled("windowed_cte", window)
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(b)
+        vocab = self.spec.vocab_size
+        last_logits = jnp.zeros((b, vocab), jnp.float32)
+        lens_d = jnp.asarray(seq_lens.astype(np.int32))
+        with self._mesh_ctx():
+            for off in range(0, s, window):
+                ids_w = jnp.asarray(input_ids[:, off:off + window])
+                pos_w = off + jnp.arange(window, dtype=jnp.int32)[None, :]
+                pos_w = jnp.broadcast_to(pos_w, (b, window))
+                # padded rows past seq_len: positions pushed out of range so
+                # their cache writes drop
+                pos_w = jnp.where(pos_w < lens_d[:, None], pos_w,
+                                  self.tpu_config.seq_len)
+                out = fn(self.params, self.cache, ids_w, pos_w, seq_ids)
+                self.cache = out["cache"]
+                # keep each row's logits at its LAST real position
+                idx = jnp.clip(lens_d - 1 - off, 0, window - 1)
+                lg = jnp.take_along_axis(
+                    out["logits_all"], idx[:, None, None], axis=1)[:, 0]
+                hit = (lens_d - 1 >= off) & (lens_d - 1 < off + window)
+                last_logits = jnp.where(hit[:, None],
+                                        lg.astype(jnp.float32), last_logits)
+            tokens = self._sample_logits(last_logits, sampling_params)
+        return {"tokens": tokens, "cache": self.cache}
+
+    def _sample_logits(self, logits, sampling_params):
+        if "sample_last" not in self._compiled:
+            from ..ops import sampling as sampling_ops
+            cfg = self.tpu_config
+
+            def fn(lg, sp, rng):
+                return sampling_ops.sample_dp(
+                    lg, cfg.on_device_sampling_config, sp, rng)
+            self._compiled["sample_last"] = jax.jit(fn)
+        return self._compiled["sample_last"](logits, sampling_params,
+                                             self._next_rng())
 
     def _run_decode(self, input_ids: np.ndarray, position_ids: np.ndarray,
                     seq_ids: Optional[np.ndarray] = None, sampling_params=None,
@@ -582,7 +642,13 @@ class CausalLMApplication:
             # teacher forcing can feed at most T tokens, producing T+1 steps
             max_new_tokens = min(max_new_tokens,
                                  np.asarray(teacher_tokens).shape[1] + 1)
-        bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
+        wcte = self.tpu_config.windowed_context_encoding
+        if wcte and s > wcte:
+            # windowed CTE pads to a window multiple instead of a ctx bucket
+            bucket = -(-s // wcte) * wcte
+        else:
+            wcte = None
+            bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
         padded = np.zeros((b, bucket), input_ids.dtype)
         padded[:, :s] = input_ids
         padded_img_mask = None
@@ -600,12 +666,22 @@ class CausalLMApplication:
                 raise ValueError("prompt exceeds seq_len")
 
         t0 = time.perf_counter()
-        out = self._run_prefill(padded, seq_lens, sampling_params=sampling_params,
-                                adapter_ids=adapter_ids,
-                                image_embeds=image_embeds,
-                                deepstack_embeds=deepstack_embeds,
-                                image_mask=padded_img_mask,
-                                rope_position_ids=padded_rope)
+        if wcte:
+            if (image_embeds is not None or adapter_ids is not None
+                    or rope_position_ids is not None or return_logits):
+                raise NotImplementedError(
+                    "windowed context encoding supports plain text prompts "
+                    "without logits output")
+            out = self._run_prefill_windowed(padded, seq_lens, wcte,
+                                             sampling_params=sampling_params)
+        else:
+            out = self._run_prefill(padded, seq_lens,
+                                    sampling_params=sampling_params,
+                                    adapter_ids=adapter_ids,
+                                    image_embeds=image_embeds,
+                                    deepstack_embeds=deepstack_embeds,
+                                    image_mask=padded_img_mask,
+                                    rope_position_ids=padded_rope)
         first = out["tokens"]                     # device array (B,)
         try:
             first.copy_to_host_async()

@@ -1797,6 +1797,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
             and kw.get("sliding_window", 0) > 0
             and kw.get("attn_chunk", 0) == 0
             and tcfg.seq_len > kw["sliding_window"]
+            and not tcfg.windowed_context_encoding
             and not tcfg.is_block_kv_layout
             and not tcfg.flash_decoding_enabled
             and not tcfg.is_continuous_batching

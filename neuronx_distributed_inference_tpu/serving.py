@@ -35,6 +35,30 @@ class _SeqState:
     running: bool = True
 
 
+def _live_rows(seqs: Dict[int, _SeqState],
+               seq_ids: Optional[Sequence[int]]) -> List[int]:
+    ids = sorted(seqs) if seq_ids is None else list(seq_ids)
+    if seq_ids is not None:
+        for sid in ids:
+            if sid not in seqs:
+                raise ValueError(f"seq_id {sid} is not running (released "
+                                 "or never added)")
+    return [sid for sid in ids if seqs[sid].running]
+
+
+def _pad_paged_rows(pad_to, ids, pos, slots, bt, last):
+    """Repeat row 0 up to the batch bucket; pad rows harmlessly rewrite
+    row 0's slots with identical values (reference: vllm_cte_repadding,
+    model_wrapper.py:1297-1313)."""
+    b = ids.shape[0]
+    if b == pad_to:
+        return ids, pos, slots, bt, last
+
+    def rep(x):
+        return np.concatenate([x, np.repeat(x[:1], pad_to - b, axis=0)])
+    return rep(ids), rep(pos), rep(slots), rep(bt), rep(last)
+
+
 class ContinuousBatchingAdapter:
     """vLLM-style engine adapter over the contiguous app
     (reference: model_wrapper.py:1297-1440)."""
@@ -90,9 +114,7 @@ class ContinuousBatchingAdapter:
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
         """One decode step for ``seq_ids`` (default: every running row).
         Returns {seq_id: next token}."""
-        live = [sid for sid in (seq_ids if seq_ids is not None
-                                else sorted(self.seqs))
-                if self.seqs[sid].running]
+        live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
         b = len(live)
@@ -153,13 +175,16 @@ class PagedEngineAdapter:
         from .modules.block_kv_cache import slots_from_table
         if len(seq_ids) != len(prompts):
             raise ValueError("seq_ids and prompts length mismatch")
+        if len(set(seq_ids)) != len(seq_ids):
+            raise ValueError("duplicate seq_ids in one add_requests call")
+        for sid in seq_ids:
+            if sid in self.seqs:
+                raise ValueError(f"seq_id {sid} already running")
         app = self.app
         b = len(seq_ids)
         lens = np.asarray([len(p) for p in prompts], np.int32)
         cached = np.zeros((b,), np.int32)
         for i, sid in enumerate(seq_ids):
-            if sid in self.seqs:
-                raise ValueError(f"seq_id {sid} already running")
             _, c = app.kv_mgr.begin_sequence(sid, list(prompts[i]))
             cached[i] = min(c, lens[i] - 1)
         width = autobucketing.get_target_bucket(
@@ -175,8 +200,14 @@ class PagedEngineAdapter:
         valid = np.arange(width)[None, :] < (lens - cached)[:, None]
         slots = slots_from_table(bt, np.where(valid, pos_w, -1),
                                  app.kv_mgr.spec.block_size)
-        out = app._run_paged(ids_w, pos_w, slots, bt,
-                             np.maximum(lens - cached - 1, 0))
+        # repad to the compiled batch bucket (repeat row 0 - pad rows
+        # rewrite row 0's slots with identical values); without this every
+        # distinct live count would jit a fresh graph mid-serving
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b)
+        ids_w, pos_w, slots, bt2, last = _pad_paged_rows(
+            pad_to, ids_w, pos_w, slots, bt,
+            np.maximum(lens - cached - 1, 0))
+        out = app._run_paged(ids_w, pos_w, slots, bt2, last)
         toks = np.asarray(out["tokens"]).reshape(-1)
         res = {}
         for i, sid in enumerate(seq_ids):
@@ -188,9 +219,7 @@ class PagedEngineAdapter:
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
         from .modules.block_kv_cache import slots_from_table
         app = self.app
-        live = [sid for sid in (seq_ids if seq_ids is not None
-                                else sorted(self.seqs))
-                if self.seqs[sid].running]
+        live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
         b = len(live)
@@ -201,8 +230,11 @@ class PagedEngineAdapter:
         bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
         slots = slots_from_table(bt, pos[:, None],
                                  app.kv_mgr.spec.block_size)
-        out = app._run_paged(toks[:, None], pos[:, None], slots, bt,
-                             np.zeros((b,), np.int32))
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b)
+        ids_p, pos_p, slots_p, bt_p, last_p = _pad_paged_rows(
+            pad_to, toks[:, None], pos[:, None], slots, bt,
+            np.zeros((b,), np.int32))
+        out = app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
         new = np.asarray(out["tokens"]).reshape(-1)[:b]
         res = {}
         for i, s in enumerate(live):

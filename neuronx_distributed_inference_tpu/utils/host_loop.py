@@ -26,6 +26,8 @@ def greedy_host_loop(step: Callable, first_tokens, max_new_tokens: int,
     extra tokens past EOS, the same convention as the main app).
     """
     collected = [first_tokens]
+    done = None
+    checked = 0
     for i in range(1, max_new_tokens):
         nxt = step(collected[-1])
         try:
@@ -35,9 +37,13 @@ def greedy_host_loop(step: Callable, first_tokens, max_new_tokens: int,
         collected.append(nxt)
         if eos_ids is not None and (i % eos_chunk == 0
                                     or i == max_new_tokens - 1):
-            # one fetch per chunk (the async copies above already moved the
-            # data); stop when every row has an EOS in what's emitted
-            toks = np.stack([np.asarray(t) for t in collected], axis=1)
-            if bool(np.isin(toks, eos_ids).any(axis=1).all()):
+            # check only the NEW chunk (already host-copied above) and OR
+            # into a running done mask - O(n) total, like the main app
+            chunk = np.stack([np.asarray(t)
+                              for t in collected[checked:]], axis=1)
+            checked = len(collected)
+            hit = np.isin(chunk, eos_ids).any(axis=1)
+            done = hit if done is None else (done | hit)
+            if bool(done.all()):
                 break
     return np.stack([np.asarray(t) for t in collected], axis=1)

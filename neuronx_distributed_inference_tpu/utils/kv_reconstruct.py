@@ -1,0 +1,104 @@
+"""KV-cache reconstruction debug utilities (reference:
+utils/kv_cache_reconstruct_utils.py, 251 LoC — the paged-layout debugging
+story): rebuild a sequence's CONTIGUOUS per-layer K/V view from any of the
+cache layouts so layouts can be diffed against each other or dumped for
+inspection.
+
+All functions are host-side (numpy in, numpy out) and read-only."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def reconstruct_contiguous(cache: Dict, row: int, length: int
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+    """Contiguous stacked cache {"k" (L,B,H,D,S) transposed-K, "v"
+    (L,B,H,S,D)} -> (k (L, length, H, D), v (L, length, H, D))."""
+    k = np.asarray(cache["k"][:, row])                   # (L, H, D, S)
+    v = np.asarray(cache["v"][:, row])                   # (L, H, S, D)
+    k_lin = np.transpose(k[:, :, :, :length], (0, 3, 1, 2))
+    v_lin = np.transpose(v[:, :, :length], (0, 2, 1, 3))
+    return k_lin, v_lin
+
+
+def reconstruct_rolling(cache: Dict, row: int, length: int
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Rolling window cache (W slots, slot = pos %% W) -> the LAST
+    min(length, W) positions in order (older positions are gone).
+    Returns (k (L, n, H, D), v (L, n, H, D), ) with n = min(length, W)."""
+    W = cache["v"].shape[3]
+    n = min(length, W)
+    positions = np.arange(length - n, length)
+    slots = positions % W
+    k = np.asarray(cache["k"][:, row])                   # (L, H, D, W)
+    v = np.asarray(cache["v"][:, row])
+    k_lin = np.transpose(k[:, :, :, slots], (0, 3, 1, 2))
+    v_lin = np.transpose(v[:, :, slots], (0, 2, 1, 3))
+    return k_lin, v_lin
+
+
+def reconstruct_mixed(cache: Dict, layer_pattern, row: int, length: int
+                      ) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+    """Mixed per-layer cache ({"k","v"} global + {"k_l","v_l"} rolling) ->
+    {absolute_layer: (k (n, H, D), v (n, H, D))} — global layers return
+    ``length`` positions, local layers their last min(length, W)."""
+    from ..modules.kv_cache import mixed_layer_map
+    lmap = mixed_layer_map(layer_pattern)
+    gk, gv = reconstruct_contiguous(
+        {"k": cache["k"], "v": cache["v"]}, row, length)
+    lk, lv = reconstruct_rolling(
+        {"k": cache["k_l"], "v": cache["v_l"]}, row, length)
+    out = {}
+    for i, is_local in enumerate(layer_pattern):
+        if is_local:
+            out[i] = (lk[lmap[i]], lv[lmap[i]])
+        else:
+            out[i] = (gk[lmap[i]], gv[lmap[i]])
+    return out
+
+
+def reconstruct_paged(cache: Dict, block_table, length: int,
+                      row: Optional[int] = None
+                      ) -> Tuple[np.ndarray, np.ndarray]:
+    """Paged cache {"k","v" (L, N, Bs, H, D)} + a sequence's block list (or
+    a (B, max_blocks) table with ``row``) -> (k (L, length, H, D),
+    v (L, length, H, D)) (reference: kv_cache_reconstruct_utils.py)."""
+    bt = np.asarray(block_table)
+    if bt.ndim == 2:
+        if row is None:
+            raise ValueError("row required with a 2-D block table")
+        bt = bt[row]
+    k = np.asarray(cache["k"])                           # (L, N, Bs, H, D)
+    v = np.asarray(cache["v"])
+    bs = k.shape[2]
+    n_blocks = -(-length // bs)
+    if n_blocks > bt.shape[0]:
+        raise ValueError(f"length {length} needs {n_blocks} blocks, table "
+                         f"has {bt.shape[0]}")
+    k_seq = k[:, bt[:n_blocks]].reshape(k.shape[0], -1, k.shape[3],
+                                        k.shape[4])[:, :length]
+    v_seq = v[:, bt[:n_blocks]].reshape(v.shape[0], -1, v.shape[3],
+                                        v.shape[4])[:, :length]
+    return k_seq, v_seq
+
+
+def diff_layouts(a: Tuple[np.ndarray, np.ndarray],
+                 b: Tuple[np.ndarray, np.ndarray],
+                 atol: float = 1e-5) -> Dict[str, float]:
+    """Compare two reconstructions; returns max-abs diffs and the first
+    mismatching (layer, position) — the cross-layout debugging primitive
+    (reference: the reconstruct-and-compare flow of
+    kv_cache_reconstruct_utils.py)."""
+    out = {}
+    for name, x, y in (("k", a[0], b[0]), ("v", a[1], b[1])):
+        x = np.asarray(x, np.float32)
+        y = np.asarray(y, np.float32)
+        d = np.abs(x - y)
+        out[f"{name}_max_abs_diff"] = float(d.max()) if d.size else 0.0
+        if d.size and d.max() > atol:
+            idx = np.unravel_index(np.argmax(d), d.shape)
+            out[f"{name}_first_mismatch"] = (int(idx[0]), int(idx[1]))
+    return out

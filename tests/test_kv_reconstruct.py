@@ -1,0 +1,113 @@
+"""KV reconstruction debug utils (reference:
+utils/kv_cache_reconstruct_utils.py): the contiguous, rolling, mixed and
+paged layouts must reconstruct to the SAME linear K/V for the same tokens."""
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.application import (
+    CausalLMApplication, PagedCausalLMApplication)
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.utils import kv_reconstruct as kvr
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5, rope_theta=10000.0,
+          hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+
+def _gen(app, ids, n=6):
+    out = app.generate(ids, max_new_tokens=n)
+    return np.asarray(out["generated"])
+
+
+def test_paged_reconstruction_matches_contiguous():
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 500, size=(2, 10), dtype=np.int64)
+    base = dict(batch_size=2, seq_len=64, dtype="float32",
+                enable_bucketing=False)
+    a_c = CausalLMApplication(None, LlamaInferenceConfig(
+        TpuConfig(**base), **HF), LlamaFamily)
+    a_c.init_random_weights(3).init_cache()
+    a_p = PagedCausalLMApplication(None, LlamaInferenceConfig(
+        TpuConfig(**base, is_block_kv_layout=True, pa_block_size=8), **HF),
+        LlamaFamily)
+    a_p.init_random_weights(3).init_cache()
+    g1 = _gen(a_c, ids)
+    g2 = _gen(a_p, ids)
+    np.testing.assert_array_equal(g1, g2)
+
+    # the final sampled token is never fed back, so the written prefix is
+    # prompt + n - 1 positions
+    length = 10 + 6 - 1
+    for row in range(2):
+        kc, vc = kvr.reconstruct_contiguous(a_c.cache, row, length)
+        bt = a_p.kv_mgr.block_table_array([row], a_p.max_blocks)
+        kp, vp = kvr.reconstruct_paged(a_p.cache, bt, length, row=0)
+        d = kvr.diff_layouts((kc, vc), (kp, vp))
+        assert d["k_max_abs_diff"] < 1e-5, d
+        assert d["v_max_abs_diff"] < 1e-5, d
+    a_p.release()
+
+
+def test_rolling_and_mixed_reconstruction():
+    """Rolling window rows hold the LAST W positions; the mixed cache's
+    global layers match the full-cache app layer-for-layer."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 250, size=(2, 11), dtype=np.int64)
+
+    from transformers import GptOssConfig, GptOssForCausalLM
+    import torch, tempfile
+    torch.manual_seed(0)
+    cfg = GptOssConfig(hidden_size=64, intermediate_size=32,
+                       num_hidden_layers=4, num_attention_heads=4,
+                       num_key_value_heads=2, head_dim=16, vocab_size=256,
+                       rms_norm_eps=1e-5, max_position_embeddings=128,
+                       rope_theta=150000.0, sliding_window=8,
+                       num_local_experts=4, num_experts_per_tok=2,
+                       tie_word_embeddings=False, attention_dropout=0.0)
+    m = GptOssForCausalLM(cfg); m.eval()
+    d = tempfile.mkdtemp()
+    m.save_pretrained(d, safe_serialization=True)
+
+    from neuronx_distributed_inference_tpu.config import load_pretrained_config
+    from neuronx_distributed_inference_tpu.models.family import get_family
+    import dataclasses
+    fam = get_family("gpt_oss")
+
+    def build(mixed):
+        tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                         enable_bucketing=False)
+        app = CausalLMApplication(
+            d, fam.config_cls(tcfg, load_config=load_pretrained_config(d)),
+            fam)
+        app.load_weights()
+        if not mixed:
+            app.spec = dataclasses.replace(app.spec, mixed_kv=False)
+        app.init_cache()
+        return app
+
+    a_full = build(mixed=False)
+    a_mix = build(mixed=True)
+    g1 = _gen(a_full, ids)
+    g2 = _gen(a_mix, ids)
+    np.testing.assert_array_equal(g1, g2)
+    length = 11 + 6 - 1
+    W = a_mix.cache["v_l"].shape[3]
+    for row in range(2):
+        full_k, full_v = kvr.reconstruct_contiguous(a_full.cache, row, length)
+        per_layer = kvr.reconstruct_mixed(a_mix.cache,
+                                          a_mix.spec.layer_pattern, row,
+                                          length)
+        for li, (k_l, v_l) in per_layer.items():
+            if a_mix.spec.layer_pattern[li]:
+                n = min(length, W)
+                np.testing.assert_allclose(k_l, full_k[li, length - n:],
+                                           atol=1e-5)
+                np.testing.assert_allclose(v_l, full_v[li, length - n:],
+                                           atol=1e-5)
+            else:
+                np.testing.assert_allclose(k_l, full_k[li], atol=1e-5)
+                np.testing.assert_allclose(v_l, full_v[li], atol=1e-5)

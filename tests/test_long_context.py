@@ -1,0 +1,87 @@
+"""Long-context validation (reference: windowed context encoding,
+models/model_base.py:878-933 + the >=32k long-context mode,
+models/config.py:612-621): windowed CTE equality at small scale, and a
+32k-token CP+SP config running end to end on the virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.application import \
+    CausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.parallel.mesh import (MeshConfig,
+                                                             build_mesh)
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5,
+          rope_theta=500000.0, hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+
+def _app(seq_len, wcte=None, mesh=None, **over):
+    tcfg = TpuConfig(batch_size=2, seq_len=seq_len, dtype="float32",
+                     enable_bucketing=False,
+                     windowed_context_encoding=wcte, **over)
+    app = CausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
+                              LlamaFamily, mesh=mesh)
+    app.init_random_weights(9).init_cache()
+    return app
+
+
+def test_windowed_cte_matches_one_shot():
+    """Windowed prefill (W=16) must reproduce one-shot prefill exactly,
+    including ragged prompt lengths (reference: model_base.py:878-933)."""
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 500, size=(2, 50), dtype=np.int64)
+    mask = np.ones_like(ids)
+    mask[1, 41:] = 0
+    ids[1, 41:] = 0
+    want = _app(96).generate(ids, attention_mask=mask, max_new_tokens=12)
+    got = _app(96, wcte=16).generate(ids, attention_mask=mask,
+                                     max_new_tokens=12)
+    np.testing.assert_array_equal(got["generated"], want["generated"])
+
+
+def test_windowed_cte_window_size_invariance():
+    """Different window sizes must agree with each other (internal
+    consistency at lengths where a one-shot golden is feasible)."""
+    rng = np.random.default_rng(1)
+    ids = rng.integers(1, 500, size=(2, 60), dtype=np.int64)
+    a = _app(128, wcte=8).generate(ids, max_new_tokens=10)
+    b = _app(128, wcte=32).generate(ids, max_new_tokens=10)
+    np.testing.assert_array_equal(a["generated"], b["generated"])
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("NXDI_RUN_SLOW"),
+                    reason="~25 min on the CPU mesh; run with "
+                           "NXDI_RUN_SLOW=1 (proof recorded in the r4 "
+                           "commit message)")
+def test_32k_context_cp_sp_windowed():
+    """>=32k context on the 8-device CPU mesh with CP+SP prefill sharding
+    and windowed CTE (reference: long-context mode, models/config.py:612-621
+    — the mechanism inventory of SURVEY §5). Asserts the full pipeline
+    (32k windowed prefill -> bucketed decode) runs and is self-consistent
+    across window sizes."""
+    S = 32768
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(1, 500, size=(2, S), dtype=np.int64)
+
+    mesh = build_mesh(MeshConfig(tp=2, cp=2, dp=2))
+    app = _app(S + 256, wcte=4096, mesh=mesh,
+               sequence_parallel_enabled=True, cp_degree=2, tp_degree=2,
+               attention_dp_degree=2)
+    out = app.generate(prompt, max_new_tokens=8)
+    gen = np.asarray(out["generated"])
+    assert gen.shape == (2, 8)
+    assert (gen > 0).any()
+
+    # window-size invariance at 32k: the decode continuation must be
+    # identical when the same prompt prefills through 8192-wide windows
+    app2 = _app(S + 256, wcte=8192, mesh=mesh,
+                sequence_parallel_enabled=True, cp_degree=2, tp_degree=2,
+                attention_dp_degree=2)
+    out2 = app2.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(out2["generated"], gen)
