@@ -785,19 +785,22 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     elif phase == "prefill":
         # flash kernel requirements beyond supports(): per-row positions must
         # be arange (the kernel rebuilds causality from array indices — an
-        # offset/chunked prefill must use the mask path), tp must be 1
-        # until the kernel is shard_map-wrapped (under GSPMD a bare
-        # pallas_call would be all-gathered and run replicated per chip),
-        # and the window/sink must be uniform across layers (static kernel)
-        if (spec.flash_prefill and arange_positions and spec.gqa.tp == 1
+        # offset/chunked prefill must use the mask path), and the
+        # window/sink must be uniform across layers (static kernel).
+        # dispatch_prefill shard_maps over the model-parallel axes for tp>1.
+        kernel_out = None
+        if (spec.flash_prefill and arange_positions
                 and spec.layer_pattern is None and not spec.attn_sink
-                and spec.mla is None
+                and spec.mla is None and not spec.cp_prefill
+                and not spec.seq_parallel
                 and flash_attention.supports(
                     q.shape[1], spec.head_dim, has_sink=False, chunk=0)):
-            attn_out = flash_attention.flash_attention(
+            kernel_out = flash_attention.dispatch_prefill(
                 q, k, v, scale=spec.scale, causal=True,
                 window=spec.sliding_window, soft_cap=spec.attn_soft_cap,
                 interpret=jax.default_backend() != "tpu")
+        if kernel_out is not None:
+            attn_out = kernel_out
         else:
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap,

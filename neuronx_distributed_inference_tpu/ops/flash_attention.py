@@ -107,6 +107,19 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     kt = jnp.transpose(k, (0, 2, 1, 3))      # (B, Hkv, S, D)
     vt = jnp.transpose(v, (0, 2, 1, 3))
 
+    def _kv_block(i, j):
+        # DMA elision (same trick as ops/decode_attention.py): clamp the
+        # k-block index into this q-block's causal/window-valid range —
+        # consecutive identical indices skip the DMA, so the causal upper
+        # triangle and out-of-window blocks cost nothing
+        jc = j
+        if causal:
+            jc = jnp.minimum(jc, (i * block_q + block_q - 1) // block_k)
+        if window > 0:
+            lo = jnp.maximum((i * block_q - window + 1) // block_k, 0)
+            jc = jnp.maximum(jc, lo)
+        return jc
+
     grid = (b, hq, s // block_q, s // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, block_q=block_q, block_k=block_k,
@@ -117,9 +130,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, h, i, j: (bi, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, h, i, j, g=g: (bi, h // g, j, 0)),
+                         lambda bi, h, i, j, g=g: (bi, h // g,
+                                                   _kv_block(i, j), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, h, i, j, g=g: (bi, h // g, j, 0)),
+                         lambda bi, h, i, j, g=g: (bi, h // g,
+                                                   _kv_block(i, j), 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d),
                                lambda bi, h, i, j: (bi, h, i, 0)),
@@ -132,6 +147,49 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         interpret=interpret,
     )(qt, kt, vt)
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def dispatch_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                     scale: float, causal: bool = True, window: int = 0,
+                     soft_cap: Optional[float] = None,
+                     interpret: bool = False) -> Optional[jnp.ndarray]:
+    """Mesh-aware prefill entry: shard_map the flash kernel over the
+    model-parallel axes (q AND kv heads split — GQA sharding already
+    pads/replicates kv heads to a multiple of tp) so tp>1 runs the kernel
+    per-shard instead of all-gathering under GSPMD (the tp=1-only
+    restriction the round-3 review flagged). Returns None when the heads
+    cannot be sharded."""
+    mesh = jax.sharding.get_abstract_mesh()
+    hq, hkv = q.shape[2], k.shape[2]
+    mp_axes = tuple(a for a in ("ep", "tp")
+                    if mesh is not None and a in mesh.axis_names
+                    and mesh.shape[a] > 1)
+    mp = 1
+    for a in mp_axes:
+        mp *= mesh.shape[a]
+    # batch over dp too (the decode dispatch does the same) — omitting it
+    # would all-gather the dp-sharded prefill activations and compute the
+    # kernel dp-times redundantly
+    dp_axes = tuple(a for a in ("dp",)
+                    if mesh is not None and a in mesh.axis_names
+                    and mesh.shape[a] > 1 and q.shape[0] % mesh.shape[a] == 0)
+    if mp == 1 and not dp_axes:
+        return flash_attention(q, k, v, scale=scale, causal=causal,
+                               window=window, soft_cap=soft_cap,
+                               interpret=interpret)
+    if mp > 1 and (hq % mp or hkv % mp or (hq // mp) % (hkv // mp)):
+        return None
+    from jax.sharding import PartitionSpec as P
+    spec = P(dp_axes if dp_axes else None, None,
+             mp_axes if mp_axes else None, None)
+
+    def body(qs, ks, vs):
+        return flash_attention(qs, ks, vs, scale=scale, causal=causal,
+                               window=window, soft_cap=soft_cap,
+                               interpret=interpret)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
 
 
 def supports(seq_len: int, head_dim: int, has_sink: bool, chunk: int,
