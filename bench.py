@@ -150,11 +150,11 @@ def main():
             return time.perf_counter() - t0
         h1 = min(t(f1) for _ in range(2))
         h2 = min(t(f2) for _ in range(2))
-        head_ms = (h2 - h1) / 48 * 1e3
+        head_ms = max((h2 - h1) / 48 * 1e3, 0.0)
         breakdown = {
             "lm_head_ms_per_step": round(head_ms, 3),
             "layers_plus_sampling_ms_per_step": round(
-                per_step * 1e3 - head_ms, 3),
+                max(per_step * 1e3 - head_ms, 0.0), 3),
         }
     except Exception as e:  # pragma: no cover - diagnostics only
         breakdown = {"error": str(e)[:120]}

@@ -792,3 +792,168 @@ class GraniteMoeFamily(DecoderFamily):
             "expert_up": np.stack(ups),
             "expert_down": np.stack(downs),
         }
+
+
+# ---------------------------------------------------------------------------
+# OLMoE (reference: contrib MoE families)
+# ---------------------------------------------------------------------------
+
+@register_family("olmoe")
+class OlmoeFamily(DecoderFamily):
+    """AllenAI OLMoE — llama attention + full-width q/k RMSNorm (olmo2
+    style) + softmax-all-then-topk MoE without renormalization."""
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        return spec_from_config(
+            config, tp_degree,
+            qk_norm_full=True,
+            moe=MoESpec(
+                num_experts=int(config.num_experts),
+                top_k=int(config.num_experts_per_tok),
+                intermediate_size=int(config.intermediate_size),
+                normalize_topk=bool(getattr(config, "norm_topk_prob",
+                                            False)),
+            ),
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             False)),
+        )
+
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec):
+        p = cls.hf_prefix
+        return cls.convert_moe_weights(
+            get, spec,
+            router_name=p + ".layers.{i}.mlp.gate.weight",
+            expert_fmt=p + ".layers.{i}.mlp.experts.{e}.{name}.weight",
+            gate="gate_proj", up="up_proj", down="down_proj")
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec):
+        g, D = spec.gqa, spec.head_dim
+        p = cls.hf_prefix
+        return {
+            "q_norm": layer_stack(
+                p + ".layers.{i}.self_attn.q_norm.weight",
+                lambda w: place_q_weight(np.asarray(w), g, D)),
+            "k_norm": layer_stack(
+                p + ".layers.{i}.self_attn.k_norm.weight",
+                lambda w: replicate_kv_weight(np.asarray(w), g, D)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# GLM-4.5 / GLM-4-MoE (reference: contrib MoE families)
+# ---------------------------------------------------------------------------
+
+@register_family("glm4_moe")
+class Glm4MoeFamily(DecoderFamily):
+    """Zhipu GLM-4-MoE — GQA attention (partial rotary, optional per-head
+    qk-norm, qkv bias) + DeepSeek-V3-style MoE: sigmoid router with
+    e_score_correction_bias (selection only), shared experts, leading
+    dense layers."""
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        nh = config.num_attention_heads
+        hd = getattr(config, "head_dim", None) or H // nh
+        moe = MoESpec(
+            num_experts=int(config.n_routed_experts),
+            top_k=int(config.num_experts_per_tok),
+            intermediate_size=int(config.moe_intermediate_size),
+            normalize_topk=bool(getattr(config, "norm_topk_prob", True)),
+            routed_scaling=float(getattr(config, "routed_scaling_factor",
+                                         1.0)),
+            router_act="sigmoid",
+            has_router_bias=True,
+            router_bias_mode="select",
+            shared_intermediate=(int(config.moe_intermediate_size)
+                                 * int(getattr(config, "n_shared_experts",
+                                               0) or 0)),
+            n_group=int(getattr(config, "n_group", 1) or 1),
+            topk_group=int(getattr(config, "topk_group", 1) or 1),
+        )
+        return spec_from_config(
+            config, tp_degree,
+            head_dim=hd,
+            moe=moe,
+            first_dense=int(getattr(config, "first_k_dense_replace", 0)),
+            qkv_bias=bool(getattr(config, "attention_bias", False)),
+            qk_norm=bool(getattr(config, "use_qk_norm", False)),
+            rotary_dim=int(hd * getattr(config, "partial_rotary_factor",
+                                        0.5)),
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             False)),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        g, D = spec.gqa, spec.head_dim
+        p = cls.hf_prefix
+        L = spec.num_layers
+        nd = spec.first_dense
+
+        def get(n):
+            return np.asarray(sd[n])
+
+        def attn_layer(i):
+            base = f"{p}.layers.{i}.self_attn"
+            out = {
+                "input_norm": _ident(get(
+                    f"{p}.layers.{i}.input_layernorm.weight")),
+                "post_norm": _ident(get(
+                    f"{p}.layers.{i}.post_attention_layernorm.weight")),
+                "q_proj": place_q_weight(_t(get(f"{base}.q_proj.weight")),
+                                         g, D, axis=-1),
+                "k_proj": replicate_kv_weight(
+                    _t(get(f"{base}.k_proj.weight")), g, D, axis=-1),
+                "v_proj": replicate_kv_weight(
+                    _t(get(f"{base}.v_proj.weight")), g, D, axis=-1),
+                "o_proj": place_q_weight(_t(get(f"{base}.o_proj.weight")),
+                                         g, D, axis=0),
+            }
+            if spec.qkv_bias:
+                out["q_bias"] = place_q_weight(get(f"{base}.q_proj.bias"),
+                                               g, D)
+                out["k_bias"] = replicate_kv_weight(
+                    get(f"{base}.k_proj.bias"), g, D)
+                out["v_bias"] = replicate_kv_weight(
+                    get(f"{base}.v_proj.bias"), g, D)
+            if spec.qk_norm:
+                out["q_norm"] = _ident(get(f"{base}.q_norm.weight"))
+                out["k_norm"] = _ident(get(f"{base}.k_norm.weight"))
+            return out
+
+        def dense_layer(i):
+            out = attn_layer(i)
+            for k in ("gate_proj", "up_proj", "down_proj"):
+                out[k] = _t(get(f"{p}.layers.{i}.mlp.{k}.weight"))
+            return out
+
+        def moe_layer(i):
+            from .deepseek.modeling_deepseek import deepseek_style_moe_weights
+            out = attn_layer(i)
+            out.update(deepseek_style_moe_weights(get, p, i, spec, _t))
+            return out
+
+        def stack_dicts(dicts):
+            return {k: np.stack([d[k] for d in dicts]) for k in dicts[0]}
+
+        out = {
+            "embed": _vpad(get(p + ".embed_tokens.weight"),
+                           spec.padded_vocab),
+            "final_norm": _ident(get(p + ".norm.weight")),
+        }
+        if nd > 0:
+            out["layers"] = stack_dicts([dense_layer(i) for i in range(nd)])
+            out["moe_layers"] = stack_dicts([moe_layer(i)
+                                             for i in range(nd, L)])
+        else:
+            out["layers"] = stack_dicts([moe_layer(i) for i in range(L)])
+        if not spec.tie_word_embeddings:
+            out["lm_head"] = _t(_vpad(get("lm_head.weight"),
+                                      spec.padded_vocab))
+        return out

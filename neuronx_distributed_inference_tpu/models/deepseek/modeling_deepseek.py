@@ -33,6 +33,36 @@ class DeepseekInferenceConfig(InferenceConfig):
                 "qk_rope_head_dim", "v_head_dim"]
 
 
+def deepseek_style_moe_weights(get, prefix: str, i: int, spec,
+                              transpose) -> Dict[str, Any]:
+    """DeepSeek-V3-shaped MoE weights for layer ``i``: sigmoid/softmax
+    router (+ optional e_score_correction_bias), per-expert gate/up/down,
+    optional shared experts. Shared by every family with this checkpoint
+    shape (deepseek v2/v3, glm4_moe)."""
+    E = spec.moe.num_experts
+    out: Dict[str, Any] = {
+        "router": transpose(get(
+            f"{prefix}.layers.{i}.mlp.gate.weight")).astype(np.float32),
+    }
+    if spec.moe.has_router_bias:
+        out["router_bias"] = np.asarray(get(
+            f"{prefix}.layers.{i}.mlp.gate.e_score_correction_bias")).astype(
+            np.float32)
+    for key, name in (("expert_gate", "gate_proj"),
+                      ("expert_up", "up_proj"),
+                      ("expert_down", "down_proj")):
+        out[key] = np.stack([
+            transpose(get(f"{prefix}.layers.{i}.mlp.experts.{e}.{name}.weight"))
+            for e in range(E)])
+    if spec.moe.shared_intermediate:
+        for key, name in (("shared_gate", "gate_proj"),
+                          ("shared_up", "up_proj"),
+                          ("shared_down", "down_proj")):
+            out[key] = transpose(get(
+                f"{prefix}.layers.{i}.mlp.shared_experts.{name}.weight"))
+    return out
+
+
 @register_family("deepseek_v3", "deepseek_v2")
 class DeepseekFamily(DecoderFamily):
     config_cls = DeepseekInferenceConfig
@@ -135,23 +165,7 @@ class DeepseekFamily(DecoderFamily):
 
         def moe_layer(i: int) -> Dict[str, np.ndarray]:
             out = attn_layer(i)
-            E = spec.moe.num_experts
-            out["router"] = t(get(f"{p}.layers.{i}.mlp.gate.weight")).astype(
-                np.float32)
-            out["router_bias"] = ident(get(
-                f"{p}.layers.{i}.mlp.gate.e_score_correction_bias")).astype(
-                np.float32)
-            for key, name in (("expert_gate", "gate_proj"),
-                              ("expert_up", "up_proj"),
-                              ("expert_down", "down_proj")):
-                out[key] = np.stack([
-                    t(get(f"{p}.layers.{i}.mlp.experts.{e}.{name}.weight"))
-                    for e in range(E)])
-            for key, name in (("shared_gate", "gate_proj"),
-                              ("shared_up", "up_proj"),
-                              ("shared_down", "down_proj")):
-                out[key] = t(get(
-                    f"{p}.layers.{i}.mlp.shared_experts.{name}.weight"))
+            out.update(deepseek_style_moe_weights(get, p, i, spec, t))
             return out
 
         def stack(dicts: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:

@@ -212,3 +212,36 @@ def test_granitemoe_matches_hf(tmp_path):
                            attention_dropout=0.0, torch_dtype="float32")
     app = _check(tmp_path, "granitemoe", GraniteMoeForCausalLM(cfg))
     assert app.spec.moe is not None and app.spec.moe.pre_softmax_topk
+
+
+def test_olmoe_matches_hf(tmp_path):
+    from transformers import OlmoeConfig, OlmoeForCausalLM
+    torch.manual_seed(0)
+    cfg = OlmoeConfig(hidden_size=64, num_attention_heads=4,
+                      num_key_value_heads=2, num_hidden_layers=3,
+                      intermediate_size=32, vocab_size=256,
+                      num_experts=4, num_experts_per_tok=2,
+                      norm_topk_prob=False, attention_dropout=0.0,
+                      torch_dtype="float32")
+    app = _check(tmp_path, "olmoe", OlmoeForCausalLM(cfg))
+    assert app.spec.qk_norm_full and app.spec.moe is not None
+    assert not app.spec.moe.normalize_topk
+
+
+def test_glm4_moe_matches_hf(tmp_path):
+    from transformers import Glm4MoeConfig, Glm4MoeForCausalLM
+    torch.manual_seed(0)
+    cfg = Glm4MoeConfig(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=3,
+                        intermediate_size=64, moe_intermediate_size=32,
+                        head_dim=16, vocab_size=256,
+                        n_routed_experts=4, num_experts_per_tok=2,
+                        n_shared_experts=1, first_k_dense_replace=1,
+                        n_group=1, topk_group=1, norm_topk_prob=True,
+                        use_qk_norm=True, attention_bias=True,
+                        partial_rotary_factor=0.5, attention_dropout=0.0,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "glm4_moe", Glm4MoeForCausalLM(cfg))
+    assert app.spec.first_dense == 1 and app.spec.qk_norm
+    assert app.spec.moe.router_act == "sigmoid"
+    assert app.spec.moe.shared_intermediate == 32
