@@ -957,3 +957,172 @@ class Glm4MoeFamily(DecoderFamily):
             out["lm_head"] = _t(_vpad(get("lm_head.weight"),
                                       spec.padded_vocab))
         return out
+
+
+# ---------------------------------------------------------------------------
+# BLOOM / MPT — ALiBi decoders (no rope, additive per-head position bias)
+# ---------------------------------------------------------------------------
+
+@register_family("bloom")
+class BloomFamily(DecoderFamily):
+    """BigScience BLOOM — ALiBi, per-head-interleaved fused QKV, embedding
+    LayerNorm, plain gelu MLP, LN+bias."""
+    config_cls = _SimpleConfig
+    hf_prefix = "transformer"
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        nh = config.n_head
+        return spec_from_config(
+            config, tp_degree,
+            num_layers=config.n_layer,
+            hidden_size=H, num_q_heads=nh, num_kv_heads=nh,
+            head_dim=H // nh,
+            intermediate_size=4 * H,
+            rms_eps=float(getattr(config, "layer_norm_epsilon", 1e-5)),
+            act="gelu_pytorch_tanh",    # bloom_gelu_forward is the tanh form
+            norm_type="layernorm", norm_bias=True,
+            mlp_glu=False, mlp_bias=True,
+            qkv_bias=True, o_bias=True,
+            no_rope=True, alibi=True, embed_norm=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        g, D = spec.gqa, spec.head_dim
+        nh = spec.num_q_heads
+        p = cls.hf_prefix
+        from ..ops.attention import alibi_slopes
+
+        def get(n):
+            return np.asarray(sd[n])
+
+        def stack(fmt, tr):
+            return np.stack([tr(get(fmt.format(i=i)))
+                             for i in range(spec.num_layers)])
+
+        qs, ks, vs, qb, kb, vb = [], [], [], [], [], []
+        for i in range(spec.num_layers):
+            w = get(f"{p}.h.{i}.self_attention.query_key_value.weight")
+            b = get(f"{p}.h.{i}.self_attention.query_key_value.bias")
+            # bloom fused layout: (nh, 3, hd, H) per-head [q, k, v]
+            w = w.reshape(nh, 3, D, -1)
+            b = b.reshape(nh, 3, D)
+            qs.append(place_q_weight(_t(w[:, 0].reshape(nh * D, -1)), g, D,
+                                     axis=-1))
+            ks.append(replicate_kv_weight(
+                _t(w[:, 1].reshape(nh * D, -1)), g, D, axis=-1))
+            vs.append(replicate_kv_weight(
+                _t(w[:, 2].reshape(nh * D, -1)), g, D, axis=-1))
+            qb.append(place_q_weight(b[:, 0].reshape(-1), g, D))
+            kb.append(replicate_kv_weight(b[:, 1].reshape(-1), g, D))
+            vb.append(replicate_kv_weight(b[:, 2].reshape(-1), g, D))
+        slopes = place_q_weight(alibi_slopes(nh, "bloom"), g, 1)
+        layers = {
+            "input_norm": stack(p + ".h.{i}.input_layernorm.weight", _ident),
+            "input_norm_b": stack(p + ".h.{i}.input_layernorm.bias", _ident),
+            "post_norm": stack(
+                p + ".h.{i}.post_attention_layernorm.weight", _ident),
+            "post_norm_b": stack(
+                p + ".h.{i}.post_attention_layernorm.bias", _ident),
+            "qkv_proj": np.concatenate(
+                [np.stack(qs), np.stack(ks), np.stack(vs)], axis=-1),
+            "qkv_bias": np.concatenate(
+                [np.stack(qb), np.stack(kb), np.stack(vb)], axis=-1),
+            "o_proj": stack(p + ".h.{i}.self_attention.dense.weight",
+                            lambda w: place_q_weight(_t(w), g, D, axis=0)),
+            "o_bias": stack(p + ".h.{i}.self_attention.dense.bias", _ident),
+            "gate_proj": stack(p + ".h.{i}.mlp.dense_h_to_4h.weight", _t),
+            "gate_bias": stack(p + ".h.{i}.mlp.dense_h_to_4h.bias", _ident),
+            "down_proj": stack(p + ".h.{i}.mlp.dense_4h_to_h.weight", _t),
+            "down_bias": stack(p + ".h.{i}.mlp.dense_4h_to_h.bias", _ident),
+            "alibi_slopes": np.broadcast_to(
+                slopes, (spec.num_layers,) + slopes.shape).copy(),
+        }
+        return {
+            "embed": _vpad(get(p + ".word_embeddings.weight"),
+                           spec.padded_vocab),
+            "embed_norm": get(p + ".word_embeddings_layernorm.weight"),
+            "embed_norm_b": get(p + ".word_embeddings_layernorm.bias"),
+            "layers": layers,
+            "final_norm": get(p + ".ln_f.weight"),
+            "final_norm_b": get(p + ".ln_f.bias"),
+        }
+
+
+@register_family("mpt")
+class MptFamily(DecoderFamily):
+    """MosaicML MPT — ALiBi, fused third-split Wqkv, bias-free everything,
+    plain gelu MLP."""
+    config_cls = _SimpleConfig
+    hf_prefix = "transformer"
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.d_model
+        nh = config.n_heads
+        ac = getattr(config, "attn_config", None)
+        if ac is not None and not getattr(ac, "alibi", True):
+            raise NotImplementedError("MPT without ALiBi (learned "
+                                      "positions) is not supported")
+        if ac is not None and getattr(ac, "alibi_bias_max", 8) != 8:
+            raise NotImplementedError("MPT alibi_bias_max != 8")
+        if not getattr(config, "no_bias", True):
+            raise NotImplementedError("MPT with biases is not supported")
+        if ac is not None and (getattr(ac, "qk_ln", False)
+                               or getattr(ac, "clip_qkv", None)):
+            raise NotImplementedError("MPT qk_ln / clip_qkv variants")
+        return spec_from_config(
+            config, tp_degree,
+            num_layers=config.n_layers,
+            hidden_size=H, num_q_heads=nh, num_kv_heads=nh,
+            head_dim=H // nh,
+            intermediate_size=int(H * getattr(config, "expansion_ratio", 4)),
+            rms_eps=float(getattr(config, "layer_norm_epsilon", 1e-5)),
+            act="gelu",
+            norm_type="layernorm", norm_bias=False,
+            mlp_glu=False, mlp_bias=False,
+            no_rope=True, alibi=True,
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        g, D = spec.gqa, spec.head_dim
+        H = spec.hidden_size
+        p = cls.hf_prefix
+        from ..ops.attention import alibi_slopes
+
+        def get(n):
+            return np.asarray(sd[n])
+
+        def stack(fmt, tr):
+            return np.stack([tr(get(fmt.format(i=i)))
+                             for i in range(spec.num_layers)])
+
+        qs, ks, vs = [], [], []
+        for i in range(spec.num_layers):
+            w = get(f"{p}.blocks.{i}.attn.Wqkv.weight")    # (3H, H) thirds
+            qs.append(place_q_weight(_t(w[:H]), g, D, axis=-1))
+            ks.append(replicate_kv_weight(_t(w[H:2 * H]), g, D, axis=-1))
+            vs.append(replicate_kv_weight(_t(w[2 * H:]), g, D, axis=-1))
+        slopes = place_q_weight(alibi_slopes(spec.num_q_heads, "mpt"), g, 1)
+        layers = {
+            "input_norm": stack(p + ".blocks.{i}.norm_1.weight", _ident),
+            "post_norm": stack(p + ".blocks.{i}.norm_2.weight", _ident),
+            "qkv_proj": np.concatenate(
+                [np.stack(qs), np.stack(ks), np.stack(vs)], axis=-1),
+            "o_proj": stack(p + ".blocks.{i}.attn.out_proj.weight",
+                            lambda w: place_q_weight(_t(w), g, D, axis=0)),
+            "gate_proj": stack(p + ".blocks.{i}.ffn.up_proj.weight", _t),
+            "down_proj": stack(p + ".blocks.{i}.ffn.down_proj.weight", _t),
+            "alibi_slopes": np.broadcast_to(
+                slopes, (spec.num_layers,) + slopes.shape).copy(),
+        }
+        return {
+            "embed": _vpad(get(p + ".wte.weight"), spec.padded_vocab),
+            "layers": layers,
+            "final_norm": get(p + ".norm_f.weight"),
+        }

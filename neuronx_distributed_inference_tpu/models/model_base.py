@@ -166,6 +166,14 @@ class DecoderSpec:
     # learned per-head softmax sinks (reference: modules/attention/sink.py,
     # gpt-oss); adds a (L, Hq) "sink" param
     attn_sink: bool = False
+    # ALiBi positional biases (bloom / mpt): score += slope_h * kv_pos —
+    # softmax shift-invariance makes the absolute form equal to the
+    # relative slope_h*(kpos-qpos); adds a (L, Hq) "alibi_slopes" param
+    # (per-layer rows are identical; stacking keeps the layer scan uniform)
+    alibi: bool = False
+    # LayerNorm over the token embeddings (bloom
+    # word_embeddings_layernorm); adds embed_norm(+_b) params
+    embed_norm: bool = False
     dtype: Any = jnp.bfloat16
     kv_dtype: Any = jnp.bfloat16
     # flash-kernel strategy (reference analog: FlashAttentionStrategy,
@@ -334,6 +342,9 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
     if spec.attn_sink:
         layers["sink"] = ParamSpec((L, spec.gqa.num_q_heads),
                                    P(None, AXIS_MP), jnp.float32, "zeros")
+    if spec.alibi:
+        layers["alibi_slopes"] = ParamSpec((L, spec.gqa.num_q_heads),
+                                           P(), jnp.float32, "zeros")
     if spec.lora is not None and spec.mla is None:
         _add_lora_specs(spec, layers, L, {
             "q_proj": (H, spec.q_size), "k_proj": (H, spec.kv_size),
@@ -433,6 +444,9 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         out["final_norm_b"] = ParamSpec((H,), P(), dt, "zeros")
     if spec.learned_pos:
         out["pos_embed"] = ParamSpec((spec.learned_pos, H), P(), dt)
+    if spec.embed_norm:
+        out["embed_norm"] = ParamSpec((H,), P(), dt, "ones")
+        out["embed_norm_b"] = ParamSpec((H,), P(), dt, "zeros")
     if spec.moe is not None and spec.first_dense > 0:
         n_dense, n_moe = spec.first_dense, L - spec.first_dense
         dense = _attn_param_specs(spec, n_dense)
@@ -678,6 +692,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     else:
         cos, sin, mask = ai["cos"], ai["sin"], ai["mask"]
     sink = layer_w["sink"] if spec.attn_sink else None
+
+    def _alibi_for(n_kv):
+        # kv slot i holds absolute position i on every contiguous path
+        if not spec.alibi:
+            return None
+        return (layer_w["alibi_slopes"],
+                jnp.arange(n_kv, dtype=jnp.int32)[None, :])
     h = (_norm(spec, hidden, layer_w["input_norm"],
                layer_w.get("input_norm_b") if spec.norm_bias else None)
          if spec.norm_position == "pre" else hidden)
@@ -755,6 +776,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         # below materializes the whole table per layer per token. Default-on
         # for single-token paged decode (decode_kernel None/True).
         use_pkernel = (hidden.shape[1] == 1
+                       and not spec.alibi
                        and spec.decode_kernel is not False
                        and decode_attention.supports(spec, 1)
                        and spec.kv_scale is None and k_full.dtype == dtype)
@@ -781,7 +803,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 dtype, spec.kv_scale)
             attn_out = attn_ops.mha(q, k_all, v_all, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap,
-                                    sink=sink)
+                                    sink=sink,
+                                    alibi=_alibi_for(k_all.shape[1]))
     elif phase == "prefill":
         # flash kernel requirements beyond supports(): per-row positions must
         # be arange (the kernel rebuilds causality from array indices — an
@@ -791,6 +814,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         kernel_out = None
         if (spec.flash_prefill and arange_positions
                 and spec.layer_pattern is None and not spec.attn_sink
+                and not spec.alibi
                 and spec.mla is None and not spec.cp_prefill
                 and not spec.seq_parallel
                 and flash_attention.supports(
@@ -802,9 +826,12 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         if kernel_out is not None:
             attn_out = kernel_out
         else:
+            # prefill kv positions = the window's own positions
+            al = ((layer_w["alibi_slopes"], positions)
+                  if spec.alibi else None)
             attn_out = attn_ops.mha(q, k, v, mask, spec.scale,
                                     logits_soft_cap=spec.attn_soft_cap,
-                                    sink=sink)
+                                    sink=sink, alibi=al)
         if spec.rolling_window and prefill_lens is not None:
             # rolling prefill write: only the LAST w positions of each row
             # land (earlier ones would alias the same slots and the scatter
@@ -850,6 +877,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                 li, seq_ids, positions, window=roll_w)
         use_kernel = (side is None
                       and not mixed_local
+                      and not spec.alibi
                       and spec.decode_kernel is not False
                       and decode_attention.supports(spec, hidden.shape[1])
                       and not spec.rolling_window
@@ -939,7 +967,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             else:
                 attn_out = attn_ops.mha_hl(q, k_all, v_all, mask, spec.scale,
                                            logits_soft_cap=spec.attn_soft_cap,
-                                           sink=sink)
+                                           sink=sink,
+                                           alibi=_alibi_for(
+                                               v_all.shape[2]))
 
     attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
@@ -1290,6 +1320,10 @@ def _embed(spec: DecoderSpec, params, input_ids, position_ids=None):
     h = params["embed"][input_ids]        # sharded-vocab gather; XLA SPMD handles
     if spec.embed_scale is not None:
         h = (h.astype(jnp.float32) * spec.embed_scale).astype(h.dtype)
+    if spec.embed_norm:
+        # bloom word_embeddings_layernorm
+        h = layer_norm(h, params["embed_norm"], params["embed_norm_b"],
+                       spec.rms_eps)
     if spec.learned_pos and position_ids is not None:
         # gpt2 wpe: learned absolute position table added to token embeds
         h = h + params["pos_embed"][jnp.clip(position_ids, 0,
@@ -1539,6 +1573,7 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                  and not spec.rolling_window
                  and not spec.flash_decoding
                  and spec.decode_kernel is not True
+                 and not spec.alibi
                  and not (spec.attn_sink or spec.sliding_window > 0
                           or spec.layer_pattern is not None
                           or spec.attn_chunk > 0))

@@ -36,10 +36,49 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
         b, s, h * n_rep, d)
 
 
+def alibi_slopes(num_heads: int, variant: str = "bloom") -> "np.ndarray":
+    """Per-head ALiBi slopes (paper 2108.12409). "bloom" reproduces HF
+    build_alibi_tensor (closest power of two + interleaved extras);
+    "mpt" reproduces build_mpt_alibi_tensor (ceil power of two with
+    alibi_bias_max=8, odd slopes first). Identical for power-of-two head
+    counts."""
+    import math
+
+    import numpy as np
+    if variant == "bloom":
+        cp2 = 2 ** math.floor(math.log2(num_heads))
+        base = 2.0 ** (-(2.0 ** -(math.log2(cp2) - 3)))
+        slopes = base ** np.arange(1, cp2 + 1, dtype=np.float64)
+        if cp2 != num_heads:
+            extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * cp2) - 3)))
+            n_extra = min(2 * cp2, num_heads) - cp2
+            extra = extra_base ** np.arange(1, 2 * n_extra, 2,
+                                            dtype=np.float64)
+            slopes = np.concatenate([slopes, extra])
+        return slopes.astype(np.float32)
+    if variant == "mpt":
+        n2 = 2 ** math.ceil(math.log2(num_heads))
+        base = np.arange(1, n2 + 1, dtype=np.float64) * (8.0 / n2)
+        slopes = 1.0 / np.power(2.0, base)
+        if n2 != num_heads:
+            slopes = np.concatenate([slopes[1::2], slopes[0::2]])[:num_heads]
+        return slopes.astype(np.float32)
+    raise ValueError(f"unknown alibi variant {variant!r}")
+
+
+def _alibi_bias(alibi, hkv: int, g: int):
+    """(slopes (Hq,), kv_pos (B,S) or (1,S)) -> additive score bias
+    (B, Hkv, G, 1, S) in fp32."""
+    slopes, kv_pos = alibi
+    sl = slopes.astype(jnp.float32).reshape(1, hkv, g, 1, 1)
+    return sl * kv_pos.astype(jnp.float32)[:, None, None, None, :]
+
+
 def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         mask: Optional[jnp.ndarray], scale: float,
         logits_soft_cap: Optional[float] = None,
-        sink: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        sink: Optional[jnp.ndarray] = None,
+        alibi=None) -> jnp.ndarray:
     """Masked multi-head attention core with GQA grouping.
 
     q (B,T,Hq,D), k/v (B,S,Hkv,D); Hq % Hkv == 0. Returns (B,T,Hq,D).
@@ -56,6 +95,8 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # scores: (B, Hkv, G, T, S)
     scores = jnp.einsum("bthgd,bshd->bhgts", qk, k,
                         preferred_element_type=jnp.float32) * scale
+    if alibi is not None:
+        scores = scores + _alibi_bias(alibi, hkv, g)
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:
@@ -87,7 +128,8 @@ def mha(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def mha_hl(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
            mask: Optional[jnp.ndarray], scale: float,
            logits_soft_cap: Optional[float] = None,
-           sink: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+           sink: Optional[jnp.ndarray] = None,
+           alibi=None) -> jnp.ndarray:
     """:func:`mha` over the native KV-cache storage layouts
     (modules/kv_cache.py): k TRANSPOSED (B, Hkv, D, S), v (B, Hkv, S, D).
     Each einsum contracts its cache operand in place — with a shared
@@ -100,6 +142,8 @@ def mha_hl(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qk = q.reshape(b, t, hkv, g, d)
     scores = jnp.einsum("bthgd,bhds->bhgts", qk, k,
                         preferred_element_type=jnp.float32) * scale
+    if alibi is not None:
+        scores = scores + _alibi_bias(alibi, hkv, g)
     if logits_soft_cap is not None:
         scores = logits_soft_cap * jnp.tanh(scores / logits_soft_cap)
     if mask is not None:

@@ -245,3 +245,44 @@ def test_glm4_moe_matches_hf(tmp_path):
     assert app.spec.first_dense == 1 and app.spec.qk_norm
     assert app.spec.moe.router_act == "sigmoid"
     assert app.spec.moe.shared_intermediate == 32
+
+
+def test_bloom_matches_hf(tmp_path):
+    from transformers import BloomConfig, BloomForCausalLM
+    torch.manual_seed(0)
+    cfg = BloomConfig(hidden_size=64, n_head=4, n_layer=3, vocab_size=256,
+                      hidden_dropout=0.0, attention_dropout=0.0,
+                      torch_dtype="float32")
+    app = _check(tmp_path, "bloom", BloomForCausalLM(cfg))
+    assert app.spec.alibi and app.spec.embed_norm and app.spec.no_rope
+
+
+def test_mpt_matches_hf(tmp_path):
+    from transformers import MptConfig, MptForCausalLM
+    torch.manual_seed(0)
+    cfg = MptConfig(d_model=64, n_heads=4, n_layers=3, vocab_size=256,
+                    torch_dtype="float32")
+    cfg.attn_config.attn_pdrop = 0.0
+    app = _check(tmp_path, "mpt", MptForCausalLM(cfg))
+    assert app.spec.alibi and not app.spec.mlp_bias
+
+
+def test_alibi_slopes_match_hf():
+    """Slope formulas must reproduce HF's build_alibi_tensor /
+    build_mpt_alibi_tensor exactly, incl. non-power-of-two head counts."""
+    import math
+    import torch as th
+    from transformers.models.bloom.modeling_bloom import build_alibi_tensor
+    from transformers.models.mpt.modeling_mpt import build_mpt_alibi_tensor
+    from neuronx_distributed_inference_tpu.ops.attention import alibi_slopes
+    for h in (4, 8, 6, 12):
+        mask = th.ones((1, 5))
+        ref = build_alibi_tensor(mask, h, th.float32)     # (h, 1, 5)
+        ref_slopes = (ref.view(h, 5)[:, 1] - ref.view(h, 5)[:, 0]).numpy()
+        np.testing.assert_allclose(alibi_slopes(h, "bloom"), ref_slopes,
+                                   rtol=1e-6)
+        ref2 = build_mpt_alibi_tensor(h, 5)               # (1, h, 1, 5)
+        ref2_slopes = (ref2.view(h, 5)[:, -1]
+                       - ref2.view(h, 5)[:, -2]).numpy()
+        np.testing.assert_allclose(alibi_slopes(h, "mpt"), ref2_slopes,
+                                   rtol=1e-5)
