@@ -36,6 +36,35 @@ def _ident(w):
     return np.asarray(w)
 
 
+def _split_interleaved_qkv(get, key_fmt, num_layers, nh, g, D,
+                           with_bias=True):
+    """Split a per-head-interleaved fused QKV stack — weights (nh, 3, D, H)
+    per layer (gpt_neox / bloom / persimmon layout) — into placed q/k/v
+    weight (and bias) stacks."""
+    qs, ks, vs, qb, kb, vb = [], [], [], [], [], []
+    for i in range(num_layers):
+        w = np.asarray(get(key_fmt.format(i=i) + ".weight"))
+        w = w.reshape(nh, 3, D, -1)
+        qs.append(place_q_weight(_t(w[:, 0].reshape(nh * D, -1)), g, D,
+                                 axis=-1))
+        ks.append(replicate_kv_weight(_t(w[:, 1].reshape(nh * D, -1)), g, D,
+                                      axis=-1))
+        vs.append(replicate_kv_weight(_t(w[:, 2].reshape(nh * D, -1)), g, D,
+                                      axis=-1))
+        if with_bias:
+            b = np.asarray(get(key_fmt.format(i=i) + ".bias")).reshape(
+                nh, 3, D)
+            qb.append(place_q_weight(b[:, 0].reshape(-1), g, D))
+            kb.append(replicate_kv_weight(b[:, 1].reshape(-1), g, D))
+            vb.append(replicate_kv_weight(b[:, 2].reshape(-1), g, D))
+    out = {"qkv_proj": np.concatenate(
+        [np.stack(qs), np.stack(ks), np.stack(vs)], axis=-1)}
+    if with_bias:
+        out["qkv_bias"] = np.concatenate(
+            [np.stack(qb), np.stack(kb), np.stack(vb)], axis=-1)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # GPT-2 (reference: contrib/models/gpt2)
 # ---------------------------------------------------------------------------
@@ -185,31 +214,15 @@ class GPTNeoXFamily(DecoderFamily):
             return np.stack([tr(get(fmt.format(i=i)))
                              for i in range(spec.num_layers)])
 
-        qs, ks, vs, qb, kb, vb = [], [], [], [], [], []
-        for i in range(spec.num_layers):
-            w = get(f"{p}.layers.{i}.attention.query_key_value.weight")
-            b = get(f"{p}.layers.{i}.attention.query_key_value.bias")
-            # (3H, H) interleaved as (nh, 3, hd, H)
-            w = w.reshape(nh, 3, D, -1)
-            b = b.reshape(nh, 3, D)
-            qs.append(place_q_weight(
-                _t(w[:, 0].reshape(nh * D, -1)), g, D, axis=-1))
-            ks.append(replicate_kv_weight(
-                _t(w[:, 1].reshape(nh * D, -1)), g, D, axis=-1))
-            vs.append(replicate_kv_weight(
-                _t(w[:, 2].reshape(nh * D, -1)), g, D, axis=-1))
-            qb.append(place_q_weight(b[:, 0].reshape(-1), g, D))
-            kb.append(replicate_kv_weight(b[:, 1].reshape(-1), g, D))
-            vb.append(replicate_kv_weight(b[:, 2].reshape(-1), g, D))
+        fused = _split_interleaved_qkv(
+            get, p + ".layers.{i}.attention.query_key_value",
+            spec.num_layers, nh, g, D)
         layers = {
             "input_norm": stack(p + ".layers.{i}.input_layernorm.weight", _ident),
             "input_norm_b": stack(p + ".layers.{i}.input_layernorm.bias", _ident),
             "post_norm": stack(p + ".layers.{i}.post_attention_layernorm.weight", _ident),
             "post_norm_b": stack(p + ".layers.{i}.post_attention_layernorm.bias", _ident),
-            "qkv_proj": np.concatenate(
-                [np.stack(qs), np.stack(ks), np.stack(vs)], axis=-1),
-            "qkv_bias": np.concatenate(
-                [np.stack(qb), np.stack(kb), np.stack(vb)], axis=-1),
+            **fused,
             "o_proj": stack(p + ".layers.{i}.attention.dense.weight",
                             lambda w: place_q_weight(_t(w), g, D, axis=0)),
             "o_bias": stack(p + ".layers.{i}.attention.dense.bias", _ident),

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..config import InferenceConfig
-from .contrib import _SimpleConfig, _ident, _t, _vpad, _vpad1
+from .contrib import (_SimpleConfig, _ident, _split_interleaved_qkv, _t, _vpad, _vpad1)
 from .family import DecoderFamily, register_family
 from .model_base import DecoderSpec, spec_from_config
 from ..modules.moe import MoESpec
@@ -1003,22 +1003,9 @@ class BloomFamily(DecoderFamily):
             return np.stack([tr(get(fmt.format(i=i)))
                              for i in range(spec.num_layers)])
 
-        qs, ks, vs, qb, kb, vb = [], [], [], [], [], []
-        for i in range(spec.num_layers):
-            w = get(f"{p}.h.{i}.self_attention.query_key_value.weight")
-            b = get(f"{p}.h.{i}.self_attention.query_key_value.bias")
-            # bloom fused layout: (nh, 3, hd, H) per-head [q, k, v]
-            w = w.reshape(nh, 3, D, -1)
-            b = b.reshape(nh, 3, D)
-            qs.append(place_q_weight(_t(w[:, 0].reshape(nh * D, -1)), g, D,
-                                     axis=-1))
-            ks.append(replicate_kv_weight(
-                _t(w[:, 1].reshape(nh * D, -1)), g, D, axis=-1))
-            vs.append(replicate_kv_weight(
-                _t(w[:, 2].reshape(nh * D, -1)), g, D, axis=-1))
-            qb.append(place_q_weight(b[:, 0].reshape(-1), g, D))
-            kb.append(replicate_kv_weight(b[:, 1].reshape(-1), g, D))
-            vb.append(replicate_kv_weight(b[:, 2].reshape(-1), g, D))
+        fused = _split_interleaved_qkv(
+            get, p + ".h.{i}.self_attention.query_key_value",
+            spec.num_layers, nh, g, D)
         slopes = place_q_weight(alibi_slopes(nh, "bloom"), g, 1)
         layers = {
             "input_norm": stack(p + ".h.{i}.input_layernorm.weight", _ident),
@@ -1027,10 +1014,7 @@ class BloomFamily(DecoderFamily):
                 p + ".h.{i}.post_attention_layernorm.weight", _ident),
             "post_norm_b": stack(
                 p + ".h.{i}.post_attention_layernorm.bias", _ident),
-            "qkv_proj": np.concatenate(
-                [np.stack(qs), np.stack(ks), np.stack(vs)], axis=-1),
-            "qkv_bias": np.concatenate(
-                [np.stack(qb), np.stack(kb), np.stack(vb)], axis=-1),
+            **fused,
             "o_proj": stack(p + ".h.{i}.self_attention.dense.weight",
                             lambda w: place_q_weight(_t(w), g, D, axis=0)),
             "o_bias": stack(p + ".h.{i}.self_attention.dense.bias", _ident),
@@ -1063,16 +1047,19 @@ class MptFamily(DecoderFamily):
     def build_spec(cls, config, tp_degree=None):
         H = config.d_model
         nh = config.n_heads
-        ac = getattr(config, "attn_config", None)
-        if ac is not None and not getattr(ac, "alibi", True):
+        ac = getattr(config, "attn_config", None) or {}
+        if not isinstance(ac, dict):      # MptConfig object vs raw JSON dict
+            ac = {k: getattr(ac, k) for k in
+                  ("alibi", "alibi_bias_max", "qk_ln", "clip_qkv")
+                  if hasattr(ac, k)}
+        if not ac.get("alibi", True):
             raise NotImplementedError("MPT without ALiBi (learned "
                                       "positions) is not supported")
-        if ac is not None and getattr(ac, "alibi_bias_max", 8) != 8:
+        if ac.get("alibi_bias_max", 8) != 8:
             raise NotImplementedError("MPT alibi_bias_max != 8")
         if not getattr(config, "no_bias", True):
             raise NotImplementedError("MPT with biases is not supported")
-        if ac is not None and (getattr(ac, "qk_ln", False)
-                               or getattr(ac, "clip_qkv", None)):
+        if ac.get("qk_ln", False) or ac.get("clip_qkv", None):
             raise NotImplementedError("MPT qk_ln / clip_qkv variants")
         return spec_from_config(
             config, tp_degree,
@@ -1126,3 +1113,134 @@ class MptFamily(DecoderFamily):
             "layers": layers,
             "final_norm": get(p + ".norm_f.weight"),
         }
+
+
+# ---------------------------------------------------------------------------
+# Persimmon (reference: contrib/models/persimmon)
+# ---------------------------------------------------------------------------
+
+@register_family("persimmon")
+class PersimmonFamily(DecoderFamily):
+    """Adept Persimmon — per-head-interleaved fused QKV with bias, per-head
+    q/k LayerNorm (with bias), partial rotary, ReLU^2 MLP, LN+bias."""
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        nh = config.num_attention_heads
+        hd = H // nh
+        return spec_from_config(
+            config, tp_degree,
+            num_kv_heads=nh,
+            head_dim=hd,
+            rms_eps=float(getattr(config, "layer_norm_eps", 1e-5)),
+            act=getattr(config, "hidden_act", "relu2"),
+            norm_type="layernorm", norm_bias=True,
+            mlp_glu=False, mlp_bias=True,
+            qkv_bias=True, o_bias=True,
+            qk_norm=bool(getattr(config, "qk_layernorm", True)),
+            qk_norm_type="layernorm",
+            rotary_dim=int(hd * getattr(config, "partial_rotary_factor",
+                                        0.5)),
+            tie_word_embeddings=False,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        g, D = spec.gqa, spec.head_dim
+        nh = spec.num_q_heads
+        p = cls.hf_prefix
+
+        def get(n):
+            return np.asarray(sd[n])
+
+        def stack(fmt, tr):
+            return np.stack([tr(get(fmt.format(i=i)))
+                             for i in range(spec.num_layers)])
+
+        fused = _split_interleaved_qkv(
+            get, p + ".layers.{i}.self_attn.query_key_value",
+            spec.num_layers, nh, g, D)
+        layers = {
+            "input_norm": stack(
+                p + ".layers.{i}.input_layernorm.weight", _ident),
+            "input_norm_b": stack(
+                p + ".layers.{i}.input_layernorm.bias", _ident),
+            "post_norm": stack(
+                p + ".layers.{i}.post_attention_layernorm.weight", _ident),
+            "post_norm_b": stack(
+                p + ".layers.{i}.post_attention_layernorm.bias", _ident),
+            **fused,
+            "o_proj": stack(p + ".layers.{i}.self_attn.dense.weight",
+                            lambda w: place_q_weight(_t(w), g, D, axis=0)),
+            "o_bias": stack(p + ".layers.{i}.self_attn.dense.bias", _ident),
+            "gate_proj": stack(
+                p + ".layers.{i}.mlp.dense_h_to_4h.weight", _t),
+            "gate_bias": stack(
+                p + ".layers.{i}.mlp.dense_h_to_4h.bias", _ident),
+            "down_proj": stack(
+                p + ".layers.{i}.mlp.dense_4h_to_h.weight", _t),
+            "down_bias": stack(
+                p + ".layers.{i}.mlp.dense_4h_to_h.bias", _ident),
+        }
+        if spec.qk_norm:
+            layers["q_norm"] = stack(
+                p + ".layers.{i}.self_attn.q_layernorm.weight", _ident)
+            layers["q_norm_b"] = stack(
+                p + ".layers.{i}.self_attn.q_layernorm.bias", _ident)
+            layers["k_norm"] = stack(
+                p + ".layers.{i}.self_attn.k_layernorm.weight", _ident)
+            layers["k_norm_b"] = stack(
+                p + ".layers.{i}.self_attn.k_layernorm.bias", _ident)
+        return {
+            "embed": _vpad(get(p + ".embed_tokens.weight"),
+                           spec.padded_vocab),
+            "layers": layers,
+            "final_norm": get(p + ".final_layernorm.weight"),
+            "final_norm_b": get(p + ".final_layernorm.bias"),
+            "lm_head": _t(_vpad(get("lm_head.weight"), spec.padded_vocab)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# dots.llm1 (rednote) — GLM-4-MoE-shaped with full rotary + per-head qk RMS
+# ---------------------------------------------------------------------------
+
+@register_family("dots1")
+class Dots1Family(Glm4MoeFamily):
+    """rednote dots.llm1 — DeepSeek-V3-style MoE (sigmoid router +
+    e_score_correction_bias, shared experts, leading dense layers) with
+    standard-GQA attention, FULL rotary and per-head q/k RMSNorm."""
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        nh = config.num_attention_heads
+        hd = getattr(config, "head_dim", None) or H // nh
+        moe = MoESpec(
+            num_experts=int(config.n_routed_experts),
+            top_k=int(config.num_experts_per_tok),
+            intermediate_size=int(config.moe_intermediate_size),
+            normalize_topk=bool(getattr(config, "norm_topk_prob", True)),
+            routed_scaling=float(getattr(config, "routed_scaling_factor",
+                                         1.0)),
+            router_act="sigmoid",
+            has_router_bias=True,
+            router_bias_mode="select",
+            shared_intermediate=(int(config.moe_intermediate_size)
+                                 * int(getattr(config, "n_shared_experts",
+                                               0) or 0)),
+            n_group=int(getattr(config, "n_group", 1) or 1),
+            topk_group=int(getattr(config, "topk_group", 1) or 1),
+        )
+        return spec_from_config(
+            config, tp_degree,
+            head_dim=hd,
+            moe=moe,
+            first_dense=int(getattr(config, "first_k_dense_replace", 0)),
+            qkv_bias=bool(getattr(config, "attention_bias", False)),
+            qk_norm=True,
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             False)),
+        )

@@ -226,6 +226,9 @@ class DecoderSpec:
     # apply the per-head q/k RMSNorm AFTER rope instead of before
     # (hunyuan-dense query/key_layernorm ordering)
     qk_norm_after_rope: bool = False
+    # per-head q/k norm flavor: "rms" (qwen3 et al) or "layernorm" with
+    # bias (persimmon q/k_layernorm)
+    qk_norm_type: str = "rms"
     # Medusa speculation heads on the target model (reference:
     # medusa_speculation, model_base.py / models/config.py:243-274):
     # head j = ResBlock(H->H) + its own lm head, predicting position +j+2
@@ -333,6 +336,9 @@ def _attn_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
                                          dt, "ones")
             layers["k_norm"] = ParamSpec((L, spec.kv_size), P(None, AXIS_MP),
                                          dt, "ones")
+    if spec.qk_norm and spec.qk_norm_type == "layernorm":
+        layers["q_norm_b"] = ParamSpec((L, spec.head_dim), P(), dt, "zeros")
+        layers["k_norm_b"] = ParamSpec((L, spec.head_dim), P(), dt, "zeros")
     if spec.o_bias:
         # row-parallel bias: replicated, added after the psum'd projection
         layers["o_bias"] = ParamSpec((L, H), P(), dt, "zeros")
@@ -731,13 +737,25 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         k = _shard(_split_heads(k, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
         v = _shard(_split_heads(v, g.num_kv_heads, spec.head_dim), AXIS_DP, None, AXIS_MP, None)
         if spec.qk_norm and not spec.qk_norm_after_rope:
-            q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
-            k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
+            if spec.qk_norm_type == "layernorm":
+                q = layer_norm(q, layer_w["q_norm"], layer_w["q_norm_b"],
+                               spec.rms_eps)
+                k = layer_norm(k, layer_w["k_norm"], layer_w["k_norm_b"],
+                               spec.rms_eps)
+            else:
+                q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+                k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         q = apply_rope(q, cos, sin, interleaved=spec.rope_interleaved)
         k = apply_rope(k, cos, sin, interleaved=spec.rope_interleaved)
         if spec.qk_norm and spec.qk_norm_after_rope:
-            q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
-            k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
+            if spec.qk_norm_type == "layernorm":
+                q = layer_norm(q, layer_w["q_norm"], layer_w["q_norm_b"],
+                               spec.rms_eps)
+                k = layer_norm(k, layer_w["k_norm"], layer_w["k_norm_b"],
+                               spec.rms_eps)
+            else:
+                q = rms_norm(q, layer_w["q_norm"], spec.rms_eps, off)
+                k = rms_norm(k, layer_w["k_norm"], spec.rms_eps, off)
         if spec.qk_l2_norm:
             # llama4: weightless L2 norm AFTER rope, rope (local) layers only
             def _l2(x):

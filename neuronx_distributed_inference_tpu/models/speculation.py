@@ -570,6 +570,10 @@ def tree_forward(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     cache slot ``write_positions[:, i]`` and attends per ``mask`` (committed
     prefix + ancestors). rope uses the node's logical position (base+depth)."""
     assert spec.layer_pattern is None, "tree verify + layer patterns TBD"
+    if spec.alibi:
+        # tree nodes occupy slots base+i with logical positions = depth, so
+        # the slot-index ALiBi bias would be silently wrong
+        raise NotImplementedError("token-tree speculation over ALiBi models")
     ai = {"mask": mask.astype(bool)}
     from ..ops.rope import rope_cos_sin
     ai["cos"], ai["sin"] = rope_cos_sin(rope_positions, spec.rope)

@@ -286,3 +286,34 @@ def test_alibi_slopes_match_hf():
                        - ref2.view(h, 5)[:, -2]).numpy()
         np.testing.assert_allclose(alibi_slopes(h, "mpt"), ref2_slopes,
                                    rtol=1e-5)
+
+
+def test_persimmon_matches_hf(tmp_path):
+    from transformers import PersimmonConfig, PersimmonForCausalLM
+    torch.manual_seed(0)
+    cfg = PersimmonConfig(hidden_size=64, num_attention_heads=4,
+                          num_hidden_layers=3, intermediate_size=128,
+                          vocab_size=256, qk_layernorm=True,
+                          partial_rotary_factor=0.5,
+                          hidden_act="relu2", attention_dropout=0.0,
+                          hidden_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "persimmon", PersimmonForCausalLM(cfg))
+    assert app.spec.qk_norm and app.spec.qk_norm_type == "layernorm"
+    assert app.spec.rope.rotary_dim == 8
+
+
+def test_dots1_matches_hf(tmp_path):
+    from transformers import Dots1Config, Dots1ForCausalLM
+    torch.manual_seed(0)
+    cfg = Dots1Config(hidden_size=64, num_attention_heads=4,
+                      num_key_value_heads=2, num_hidden_layers=3,
+                      intermediate_size=64, moe_intermediate_size=32,
+                      head_dim=16, vocab_size=256,
+                      n_routed_experts=4, num_experts_per_tok=2,
+                      n_shared_experts=1, first_k_dense_replace=1,
+                      n_group=1, topk_group=1, norm_topk_prob=True,
+                      routed_scaling_factor=1.0,
+                      attention_dropout=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "dots1", Dots1ForCausalLM(cfg))
+    assert app.spec.qk_norm and app.spec.moe.router_act == "sigmoid"
+    assert app.spec.first_dense == 1
