@@ -54,6 +54,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--no-bucketing", dest="enable_bucketing",
                      action="store_false")
     run.add_argument("--decode-chunk-tokens", type=int, default=1)
+    run.add_argument("--enable-2d-bucketing", action="store_true",
+                     help="batch x seq TKG buckets + paged table-width "
+                          "buckets (reference: autobucketing.py:22-64,203)")
+    run.add_argument("--windowed-context-encoding", type=int, default=None,
+                     help="prefill window size for >=32k prompts "
+                          "(reference: model_base.py:878-933)")
     # quantization (reference: models/config.py:216-241)
     run.add_argument("--quantized", action="store_true")
     run.add_argument("--quantization-dtype", default="int8",
@@ -149,6 +155,8 @@ def run_inference(args) -> int:
             sequence_parallel_enabled=args.sequence_parallel,
             flash_decoding_enabled=args.flash_decoding,
             enable_bucketing=args.enable_bucketing,
+            enable_2d_bucketing=args.enable_2d_bucketing,
+            windowed_context_encoding=args.windowed_context_encoding,
             decode_chunk_tokens=args.decode_chunk_tokens,
             on_device_sampling_config=sampling_cfg,
             quantized=args.quantized,
