@@ -1244,3 +1244,35 @@ class Dots1Family(Glm4MoeFamily):
             tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
                                              False)),
         )
+
+
+# ---------------------------------------------------------------------------
+# CodeGen (Salesforce) — GPT-J sibling with mp_num-blocked fused QKV
+# ---------------------------------------------------------------------------
+
+@register_family("codegen")
+class CodeGenFamily(GPTJFamily):
+    """CodeGen — GPT-J architecture (parallel-shared residual, interleaved
+    partial rotary, gelu MLP, biased untied lm_head) with the fused
+    qkv_proj laid out as mp_num=4 blocks of [q | v | k] head groups."""
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        # de-block the mp_num=4 fused qkv into the synthetic per-projection
+        # names GPT-J uses, then delegate to its converter
+        nh = spec.num_q_heads
+        D = spec.head_dim
+        p = cls.hf_prefix
+        mp_num = 4
+        local = nh * D // mp_num
+        sd = dict(sd)
+        for i in range(spec.num_layers):
+            w = np.asarray(sd[f"{p}.h.{i}.attn.qkv_proj.weight"])
+            w = w.reshape(mp_num, 3 * local, -1)
+            sd[f"{p}.h.{i}.attn.q_proj.weight"] = \
+                w[:, :local].reshape(nh * D, -1)
+            sd[f"{p}.h.{i}.attn.v_proj.weight"] = \
+                w[:, local:2 * local].reshape(nh * D, -1)
+            sd[f"{p}.h.{i}.attn.k_proj.weight"] = \
+                w[:, 2 * local:].reshape(nh * D, -1)
+        return super().convert_hf_state_dict(sd, spec)

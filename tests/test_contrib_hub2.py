@@ -317,3 +317,15 @@ def test_dots1_matches_hf(tmp_path):
     app = _check(tmp_path, "dots1", Dots1ForCausalLM(cfg))
     assert app.spec.qk_norm and app.spec.moe.router_act == "sigmoid"
     assert app.spec.first_dense == 1
+
+
+def test_codegen_matches_hf(tmp_path):
+    from transformers import CodeGenConfig, CodeGenForCausalLM
+    torch.manual_seed(0)
+    cfg = CodeGenConfig(n_embd=64, n_head=4, n_layer=3, n_positions=128,
+                        rotary_dim=8, vocab_size=256, resid_pdrop=0.0,
+                        embd_pdrop=0.0, attn_pdrop=0.0,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "codegen", CodeGenForCausalLM(cfg))
+    assert app.spec.block_style == "parallel_shared"
+    assert app.spec.rope_interleaved
