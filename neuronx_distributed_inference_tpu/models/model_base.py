@@ -665,13 +665,9 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
       the gathered view — covers paged prefill, prefix-cached continuation,
       chunked prefill and paged decode with one body.
     """
-    g = spec.gqa
-    dtype = hidden.dtype
-    off = spec.norm_offset
     if mlp_kind is None:
         mlp_kind = "dense" if spec.moe is None else "moe"
     caps: Dict[str, Any] = {}
-    pending = None
 
     def _tap(name, val):
         """Tensor replacement (golden injection) then capture at one point
@@ -683,6 +679,116 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         if spec.capture and name in spec.capture:
             caps[name] = val
         return val
+    h = (_norm(spec, hidden, layer_w["input_norm"],
+               layer_w.get("input_norm_b") if spec.norm_bias else None)
+         if spec.norm_position == "pre" else hidden)
+    attn_in = h        # parallel blocks feed the MLP from the same norm
+    h, k_full, v_full, pending = _attn_block(
+        spec, h, layer_w, k_full, v_full, li, ai, is_local, seq_ids,
+        positions, phase, identity_seq_ids=identity_seq_ids,
+        arange_positions=arange_positions, slot_mapping=slot_mapping,
+        block_table=block_table, adapter_ids=adapter_ids, kv_view=kv_view,
+        prefill_lens=prefill_lens, side=side, mixed_local=mixed_local)
+    if spec.sandwich_norm:
+        h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps,
+                     spec.norm_offset)
+    h = _tap("attn_output", h)
+    # SP: residual stream stays seq-sharded between blocks during prefill
+    # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
+    sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
+
+    def _mlp(x_in):
+        return _mlp_block(spec, x_in, layer_w, mlp_kind, adapter_ids)
+
+    if spec.block_style != "sequential":
+        # parallel residual: x + attn(norm(x)) + mlp(norm'(x)) (falcon
+        # parallel_attn / phi share the attention norm; gpt-neox
+        # use_parallel_residual has its own post norm over the INPUT)
+        mlp_in = attn_in if spec.block_style == "parallel_shared" else \
+            _norm(spec, hidden, layer_w["post_norm"],
+                  layer_w.get("post_norm_b") if spec.norm_bias else None)
+        m = _tap("mlp_output", _mlp(mlp_in))
+        hidden = hidden + spec.residual_multiplier * _shard(
+            h + m, AXIS_DP, sp_axis, None)
+        hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
+        hidden = _tap("layer_output", hidden)
+        if side is not None:
+            return hidden, k_full, v_full, caps, pending
+        return hidden, k_full, v_full, caps
+
+    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
+
+    h = (_norm(spec, hidden, layer_w["post_norm"],
+               layer_w.get("post_norm_b") if spec.norm_bias else None)
+         if spec.norm_position == "pre" else hidden)
+    h = _mlp(h)
+    if spec.sandwich_norm:
+        h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps,
+                     spec.norm_offset)
+    h = _tap("mlp_output", h)
+    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
+    hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
+    hidden = _tap("layer_output", hidden)
+    if side is not None:
+        return hidden, k_full, v_full, caps, pending
+    return hidden, k_full, v_full, caps
+
+
+def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids):
+    """The MLP / MoE half of a layer (GLU, plain 2-layer, or routed MoE)."""
+    if mlp_kind == "moe":
+        return moe_block(spec.moe, x_in, layer_w)
+    act = ACT_FNS[spec.act]
+    if not spec.mlp_glu:
+        # plain 2-layer MLP (gpt2/falcon/starcoder2/phi/neox):
+        # gate_proj/down_proj slots hold fc1/fc2
+        inter = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
+                           qlinear(x_in, layer_w["gate_proj"]),
+                           adapter_ids)
+        if spec.mlp_bias:
+            inter = inter + layer_w["gate_bias"]
+        inter = _shard(act(inter), AXIS_DP, None, AXIS_MP)
+        y = apply_lora(spec.lora, layer_w, "down_proj", inter,
+                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
+        if spec.mlp_bias:
+            y = y + layer_w["down_bias"]
+        return y
+    gate = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
+                      qlinear(x_in, layer_w["gate_proj"]), adapter_ids)
+    up = apply_lora(spec.lora, layer_w, "up_proj", x_in,
+                    qlinear(x_in, layer_w["up_proj"]), adapter_ids)
+    if spec.mlp_bias:
+        gate = gate + layer_w["gate_bias"]
+        up = up + layer_w["up_bias"]
+    inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
+    y = apply_lora(spec.lora, layer_w, "down_proj", inter,
+                   qlinear(inter, layer_w["down_proj"]), adapter_ids)
+    if spec.mlp_bias:
+        y = y + layer_w["down_bias"]
+    return y
+
+
+def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
+                is_local, seq_ids, positions, phase: str, *,
+                identity_seq_ids=False, arange_positions=False,
+                slot_mapping=None, block_table=None, adapter_ids=None,
+                kv_view=None, prefill_lens=None, side=None,
+                mixed_local=None):
+    """The attention half of a layer: q/k/v projections, cache write, the
+    phase-appropriate attention compute (Pallas kernel or XLA), and the
+    output projection. ``h`` is the already-normed block input (B, T, H).
+    Exposed (like ``run_layer_slice``) so families with non-standard block
+    structures — the hybrid attention+SSM layers of Falcon-H1
+    (reference: contrib/models/Falcon-H1-0.5B-Instruct/src/
+    modeling_falcon_h1.py FalconH1DecoderLayer) — can stitch it next to
+    their own temporal-mixing blocks.
+
+    Returns (attn_h, k_full, v_full, pending): attn_h the post-o_proj
+    hidden delta, pending the chunked-decode side-buffer pair (None unless
+    ``side`` is set)."""
+    g = spec.gqa
+    dtype = h.dtype
+    off = spec.norm_offset
     if mixed_local is not None:
         # mixed per-layer cache (gpt-oss): the local/global choice is
         # STATIC per unrolled layer — the local mask is rolling-shaped (W
@@ -705,10 +811,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
             return None
         return (layer_w["alibi_slopes"],
                 jnp.arange(n_kv, dtype=jnp.int32)[None, :])
-    h = (_norm(spec, hidden, layer_w["input_norm"],
-               layer_w.get("input_norm_b") if spec.norm_bias else None)
-         if spec.norm_position == "pre" else hidden)
-    attn_in = h        # parallel blocks feed the MLP from the same norm
+    pending = None
     if spec.mla is not None:
         q, k, v = _mla_qkv(spec, h, layer_w, cos, sin)
     else:
@@ -793,7 +896,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         # each row's LIVE pages through the block table — the gather path
         # below materializes the whole table per layer per token. Default-on
         # for single-token paged decode (decode_kernel None/True).
-        use_pkernel = (hidden.shape[1] == 1
+        use_pkernel = (h.shape[1] == 1
                        and not spec.alibi
                        and spec.decode_kernel is not False
                        and decode_attention.supports(spec, 1)
@@ -897,10 +1000,10 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                       and not mixed_local
                       and not spec.alibi
                       and spec.decode_kernel is not False
-                      and decode_attention.supports(spec, hidden.shape[1])
+                      and decode_attention.supports(spec, h.shape[1])
                       and not spec.rolling_window
                       and identity_seq_ids
-                      and hidden.shape[0] == k_full.shape[1]
+                      and h.shape[0] == k_full.shape[1]
                       and spec.kv_scale is None and k_full.dtype == dtype
                       and not spec.flash_decoding)
         if use_kernel and spec.decode_kernel is None:
@@ -961,7 +1064,7 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                     # is built against the same kv_view length)
                     k_layer = k_layer[:, :, :, :view]
                     v_layer = v_layer[:, :, :view]
-            if identity_seq_ids and hidden.shape[0] == k_full.shape[1]:
+            if identity_seq_ids and h.shape[0] == k_full.shape[1]:
                 # static guarantee that seq_ids == arange (no continuous
                 # batching): skip the row-gather copy of the whole cache
                 k_all = kv.dequantize_kv(k_layer, dtype, spec.kv_scale)
@@ -989,81 +1092,13 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
                                            alibi=_alibi_for(
                                                v_all.shape[2]))
 
-    attn_out = attn_out.reshape(hidden.shape[0], hidden.shape[1], -1)
+    attn_out = attn_out.reshape(h.shape[0], h.shape[1], -1)
     h = qlinear(attn_out, layer_w["o_proj"])
     if spec.mla is None:
         h = apply_lora(spec.lora, layer_w, "o_proj", attn_out, h, adapter_ids)
     if spec.o_bias:
         h = h + layer_w["o_bias"]
-    if spec.sandwich_norm:
-        h = rms_norm(h, layer_w["post_attn_norm"], spec.rms_eps, off)
-    h = _tap("attn_output", h)
-    # SP: residual stream stays seq-sharded between blocks during prefill
-    # (reference: sequence-parallel reduce-scatter, model_base.py:1482-1517)
-    sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
-
-    def _mlp(x_in):
-        if mlp_kind == "moe":
-            return moe_block(spec.moe, x_in, layer_w)
-        act = ACT_FNS[spec.act]
-        if not spec.mlp_glu:
-            # plain 2-layer MLP (gpt2/falcon/starcoder2/phi/neox):
-            # gate_proj/down_proj slots hold fc1/fc2
-            inter = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
-                               qlinear(x_in, layer_w["gate_proj"]),
-                               adapter_ids)
-            if spec.mlp_bias:
-                inter = inter + layer_w["gate_bias"]
-            inter = _shard(act(inter), AXIS_DP, None, AXIS_MP)
-            y = apply_lora(spec.lora, layer_w, "down_proj", inter,
-                           qlinear(inter, layer_w["down_proj"]), adapter_ids)
-            if spec.mlp_bias:
-                y = y + layer_w["down_bias"]
-            return y
-        gate = apply_lora(spec.lora, layer_w, "gate_proj", x_in,
-                          qlinear(x_in, layer_w["gate_proj"]), adapter_ids)
-        up = apply_lora(spec.lora, layer_w, "up_proj", x_in,
-                        qlinear(x_in, layer_w["up_proj"]), adapter_ids)
-        if spec.mlp_bias:
-            gate = gate + layer_w["gate_bias"]
-            up = up + layer_w["up_bias"]
-        inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
-        y = apply_lora(spec.lora, layer_w, "down_proj", inter,
-                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
-        if spec.mlp_bias:
-            y = y + layer_w["down_bias"]
-        return y
-
-    if spec.block_style != "sequential":
-        # parallel residual: x + attn(norm(x)) + mlp(norm'(x)) (falcon
-        # parallel_attn / phi share the attention norm; gpt-neox
-        # use_parallel_residual has its own post norm over the INPUT)
-        mlp_in = attn_in if spec.block_style == "parallel_shared" else             _norm(spec, hidden, layer_w["post_norm"],
-                  layer_w.get("post_norm_b") if spec.norm_bias else None)
-        m = _tap("mlp_output", _mlp(mlp_in))
-        hidden = hidden + spec.residual_multiplier * _shard(
-            h + m, AXIS_DP, sp_axis, None)
-        hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
-        hidden = _tap("layer_output", hidden)
-        if side is not None:
-            return hidden, k_full, v_full, caps, pending
-        return hidden, k_full, v_full, caps
-
-    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
-
-    h = (_norm(spec, hidden, layer_w["post_norm"],
-               layer_w.get("post_norm_b") if spec.norm_bias else None)
-         if spec.norm_position == "pre" else hidden)
-    h = _mlp(h)
-    if spec.sandwich_norm:
-        h = rms_norm(h, layer_w["post_ff_norm"], spec.rms_eps, off)
-    h = _tap("mlp_output", h)
-    hidden = hidden + spec.residual_multiplier * _shard(h, AXIS_DP, sp_axis, None)
-    hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
-    hidden = _tap("layer_output", hidden)
-    if side is not None:
-        return hidden, k_full, v_full, caps, pending
-    return hidden, k_full, v_full, caps
+    return h, k_full, v_full, pending
 
 
 def _deepstack_add(hidden, deepstack, deepstack_mask):

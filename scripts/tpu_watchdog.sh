@@ -1,0 +1,27 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; the moment it answers, run bench.py and persist
+# the result to BENCH_interim.json (front-loading perf evidence per the
+# round-4 outage lesson). Loops forever; caller kills it.
+cd "$(dirname "$0")/.." || exit 1
+while true; do
+  if timeout 90 python - <<'EOF' 2>/tmp/tpu_health_err.log
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+(x @ x).block_until_ready()
+print("TPU OK", jax.devices())
+EOF
+  then
+    echo "$(date -Is) tunnel UP — running bench" >> /tmp/tpu_watchdog.log
+    timeout 1800 python bench.py > /tmp/bench_out.json 2>/tmp/bench_err.log
+    rc=$?
+    if [ $rc -eq 0 ] && [ -s /tmp/bench_out.json ]; then
+      cp /tmp/bench_out.json /root/repo/BENCH_interim.json
+      echo "$(date -Is) bench OK" >> /tmp/tpu_watchdog.log
+      exit 0
+    fi
+    echo "$(date -Is) bench rc=$rc" >> /tmp/tpu_watchdog.log
+  else
+    echo "$(date -Is) tunnel down" >> /tmp/tpu_watchdog.log
+  fi
+  sleep 120
+done
