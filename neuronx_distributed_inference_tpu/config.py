@@ -99,6 +99,15 @@ class MoEConfig:
     routed_scaling_factor: Optional[float] = None
     moe_tp_degree: Optional[int] = None       # defaults to tp_degree
     moe_ep_degree: Optional[int] = None       # defaults to ep_degree
+    # hybrid CTE/TKG expert sharding (reference: moe_v2.py:135-161
+    # HybridShardingConfig): moe_tkg_ep_degree=1 switches DECODE to
+    # all-experts-local with the intermediate dim split over every model
+    # axis; prefill keeps the ep-sharded layout. Other degree combinations
+    # are not supported (the GSPMD mesh fixes the axis extents).
+    moe_cte_tp_degree: Optional[int] = None
+    moe_cte_ep_degree: Optional[int] = None
+    moe_tkg_tp_degree: Optional[int] = None
+    moe_tkg_ep_degree: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)

@@ -177,7 +177,8 @@ class CausalLMApplication:
     def init_cache(self):
         cfg = self.tpu_config
         spec = KVCacheSpec(
-            num_layers=self.spec.num_layers,
+            # SSM-only layers carry no KV rows (recurrent/hybrid stacks)
+            num_layers=self.spec.num_attn_layers,
             batch_size=cfg.kv_cache_batch_size,
             max_seq_len=cfg.seq_len,
             num_kv_heads=self.spec.gqa.num_kv_heads,
@@ -200,6 +201,14 @@ class CausalLMApplication:
         else:
             self.cache = init_cache(spec, self.mesh,
                                     flash_decoding=self.spec.flash_decoding)
+        if self.spec.ssm is not None:
+            # recurrent state pytree rides the same cache dict (reference
+            # analog: the conv/ssm state tensors of
+            # contrib Falcon-H1 FalconHybridMambaAttentionDynamicCache)
+            from ..modules.ssm import init_ssm_state
+            self.cache.update(init_ssm_state(
+                self.spec.ssm, self.spec.num_ssm_layers,
+                cfg.kv_cache_batch_size, self.spec.dtype, self.mesh))
         return self
 
     # ------------------------------------------------------------------

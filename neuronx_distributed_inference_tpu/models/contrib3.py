@@ -1,0 +1,356 @@
+"""Contrib hub wave 3 (reference: contrib/models/ — SURVEY §2.7):
+openai-gpt (post-LN GPT-1), LFM2 (hybrid short-conv + attention),
+VaultGemma, Apertus (xIELU), Phi-3.5-MoE (sparsemixer routing)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import InferenceConfig
+from ..modules.moe import MoESpec
+from ..modules.ssm import SSMSpec
+from ..parallel.layers import place_q_weight, replicate_kv_weight
+from .contrib import GPT2Family, _SimpleConfig, _ident, _t
+from .family import DecoderFamily, register_family
+from .model_base import spec_from_config
+
+
+@register_family("openai-gpt")
+class OpenAIGPTFamily(GPT2Family):
+    """GPT-1 (reference: contrib/models/openai-gpt): gpt2-shaped fused
+    Conv1D attention + learned positions, but POST-layernorm blocks
+    (x = ln(x + sublayer(x))) and no final norm."""
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.n_embd
+        nh = config.n_head
+        return spec_from_config(
+            config, tp_degree,
+            num_layers=config.n_layer,
+            hidden_size=H,
+            num_q_heads=nh, num_kv_heads=nh, head_dim=H // nh,
+            intermediate_size=getattr(config, "n_inner", None) or 4 * H,
+            rms_eps=float(getattr(config, "layer_norm_epsilon", 1e-5)),
+            act={"gelu": "gelu_new", "gelu_new": "gelu_new",
+                 "relu": "relu", "silu": "silu"}.get(
+                getattr(config, "afn", "gelu"), "gelu_new"),
+            norm_type="layernorm", norm_bias=True,
+            norm_position="post_residual", skip_final_norm=True,
+            mlp_glu=False, mlp_bias=True,
+            qkv_bias=True, o_bias=True,
+            no_rope=True,
+            learned_pos=int(getattr(config, "n_positions", 512)),
+            tie_word_embeddings=True,
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        sd = dict(sd)
+        p = cls.hf_prefix
+        # tokens_embed/positions_embed -> the gpt2 wte/wpe names the base
+        # converter consumes; no ln_f exists (skip_final_norm)
+        sd[p + ".wte.weight"] = sd.pop(p + ".tokens_embed.weight")
+        sd[p + ".wpe.weight"] = sd.pop(p + ".positions_embed.weight")
+        H = spec.hidden_size
+        sd[p + ".ln_f.weight"] = np.ones((H,), np.float32)
+        sd[p + ".ln_f.bias"] = np.zeros((H,), np.float32)
+        out = super().convert_hf_state_dict(sd, spec)
+        out.pop("final_norm", None)
+        out.pop("final_norm_b", None)
+        return out
+
+
+class Lfm2InferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "vocab_size", "layer_types", "conv_L_cache"]
+
+    def get_text_config(self):
+        return self
+
+
+@register_family("lfm2")
+class Lfm2Family(DecoderFamily):
+    """Liquid LFM2 (reference: contrib/models/lfm2-2.6b): interleaved
+    gated-short-conv and attention layers on the recurrent state axis,
+    per-head q/k RMSNorm applied BEFORE rope, w1/w3/w2 GLU MLP."""
+
+    config_cls = Lfm2InferenceConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        inter = config.intermediate_size
+        if getattr(config, "block_auto_adjust_ff_dim", False):
+            inter = int(2 * inter / 3)
+            mult = getattr(config, "block_ffn_dim_multiplier", None)
+            if mult is not None:
+                inter = int(mult * inter)
+            mo = int(getattr(config, "block_multiple_of", 256))
+            inter = mo * ((inter + mo - 1) // mo)
+        lt = list(config.layer_types)
+        return spec_from_config(
+            config, tp_degree,
+            intermediate_size=inter,
+            rms_eps=float(getattr(config, "norm_eps", 1e-5)),
+            qk_norm=True,
+            ssm=SSMSpec(kind="shortconv", d_inner=H, num_heads=1,
+                        head_dim=H,
+                        d_conv=int(config.conv_L_cache),
+                        conv_bias=bool(getattr(config, "conv_bias", False))),
+            ssm_pattern=tuple(t == "conv" for t in lt),
+            ssm_parallel=False,
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             True)),
+        )
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        g, D = spec.gqa, spec.head_dim
+        H = spec.hidden_size
+        pat = spec.resolved_ssm_pattern
+
+        def get(n):
+            return np.asarray(sd[n])
+
+        def stack(idx, fmt, tr):
+            return np.stack([tr(get(fmt.format(i=i))) for i in idx])
+
+        all_i = list(range(spec.num_layers))
+        attn_i = [i for i in all_i if not pat[i]]
+        conv_i = [i for i in all_i if pat[i]]
+        p = "model.layers.{i}."
+
+        layers = {
+            "input_norm": stack(all_i, p + "operator_norm.weight", _ident),
+            "post_norm": stack(all_i, p + "ffn_norm.weight", _ident),
+            "gate_proj": stack(all_i, p + "feed_forward.w1.weight", _t),
+            "up_proj": stack(all_i, p + "feed_forward.w3.weight", _t),
+            "down_proj": stack(all_i, p + "feed_forward.w2.weight", _t),
+        }
+        attn_layers = {} if not attn_i else {
+            "qkv_proj": np.concatenate([
+                stack(attn_i, p + "self_attn.q_proj.weight",
+                      lambda w: place_q_weight(_t(w), g, D, axis=-1)),
+                stack(attn_i, p + "self_attn.k_proj.weight",
+                      lambda w: replicate_kv_weight(_t(w), g, D, axis=-1)),
+                stack(attn_i, p + "self_attn.v_proj.weight",
+                      lambda w: replicate_kv_weight(_t(w), g, D, axis=-1)),
+            ], axis=-1),
+            "o_proj": stack(attn_i, p + "self_attn.out_proj.weight",
+                            lambda w: place_q_weight(_t(w), g, D, axis=0)),
+            "q_norm": stack(attn_i, p + "self_attn.q_layernorm.weight",
+                            _ident),
+            "k_norm": stack(attn_i, p + "self_attn.k_layernorm.weight",
+                            _ident),
+        }
+        ssm_layers = {} if not conv_i else {
+            # in_proj rows [B | C | x] (HF BCx chunk order)
+            "sc_in_b": stack(conv_i, p + "conv.in_proj.weight",
+                             lambda w: _t(np.asarray(w)[:H])),
+            "sc_in_c": stack(conv_i, p + "conv.in_proj.weight",
+                             lambda w: _t(np.asarray(w)[H:2 * H])),
+            "sc_in_x": stack(conv_i, p + "conv.in_proj.weight",
+                             lambda w: _t(np.asarray(w)[2 * H:])),
+            "sc_conv": stack(conv_i, p + "conv.conv.weight",
+                             lambda w: np.asarray(w)[:, 0, :]),
+            "sc_out": stack(conv_i, p + "conv.out_proj.weight", _t),
+        }
+        if spec.ssm.conv_bias and conv_i:
+            ssm_layers["sc_conv_b"] = stack(
+                conv_i, p + "conv.conv.bias", _ident)
+            ssm_layers["sc_out_b"] = stack(
+                conv_i, p + "conv.out_proj.bias", _ident)
+            for part, key in (("b", "sc_in_b_b"), ("c", "sc_in_c_b"),
+                              ("x", "sc_in_x_b")):
+                lo = {"b": 0, "c": H, "x": 2 * H}[part]
+                ssm_layers[key] = stack(
+                    conv_i, p + "conv.in_proj.bias",
+                    lambda bvec, lo=lo: np.asarray(bvec)[lo:lo + H])
+
+        def vpad(w):
+            if w.shape[0] < spec.padded_vocab:
+                w = np.pad(w, [(0, spec.padded_vocab - w.shape[0]), (0, 0)])
+            return w
+
+        out = {
+            "embed": vpad(get("model.embed_tokens.weight")),
+            "layers": layers,
+            "final_norm": get("model.embedding_norm.weight"),
+        }
+        if attn_layers:
+            out["attn_layers"] = attn_layers
+        if ssm_layers:
+            out["ssm_layers"] = ssm_layers
+        if not spec.tie_word_embeddings:
+            out["lm_head"] = np.ascontiguousarray(
+                vpad(get("lm_head.weight")).T)
+        return out
+
+    @classmethod
+    def load_hf_model(cls, model_path: str):
+        import transformers
+        return transformers.Lfm2ForCausalLM.from_pretrained(model_path)
+
+
+@register_family("vaultgemma")
+class VaultGemmaFamily(DecoderFamily):
+    """VaultGemma (reference: contrib/models/vaultgemma-1b): gemma2-style
+    soft caps + alternating sliding/full layers, but only two pre-norms
+    per layer (no sandwich norms)."""
+
+    config_cls = _SimpleConfig
+    post_norm_src = "pre_feedforward_layernorm"
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        H = config.hidden_size
+        lt = list(getattr(config, "layer_types", []) or [])
+        pattern = (tuple(t == "sliding_attention" for t in lt)
+                   if lt and not all(t == lt[0] for t in lt) else None)
+        window = int(getattr(config, "sliding_window", 0) or 0)
+        qpre = getattr(config, "query_pre_attn_scalar", None)
+        return spec_from_config(
+            config, tp_degree,
+            act=getattr(config, "hidden_activation", "gelu_pytorch_tanh"),
+            embed_scale=math.sqrt(H),
+            norm_offset=1.0,
+            attn_scale=(float(qpre) ** -0.5 if qpre else None),
+            attn_soft_cap=getattr(config, "attn_logit_softcapping", None),
+            logits_soft_cap=getattr(config, "final_logit_softcapping", None),
+            sliding_window=window,
+            layer_pattern=pattern,
+            qkv_bias=bool(getattr(config, "attention_bias", False)),
+            o_bias=bool(getattr(config, "attention_bias", False)),
+            tie_word_embeddings=bool(getattr(config, "tie_word_embeddings",
+                                             True)),
+        )
+
+
+class ApertusInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_attention_heads", "num_hidden_layers",
+                "num_key_value_heads", "vocab_size", "intermediate_size"]
+
+    def get_text_config(self):
+        return self
+
+
+@register_family("apertus")
+class ApertusFamily(DecoderFamily):
+    """Swiss AI Apertus (reference: contrib/models/Apertus-8B-Instruct-2509):
+    llama attention + per-head q/k RMSNorm before rope + plain up/down MLP
+    with the learned-alpha xIELU activation."""
+
+    config_cls = ApertusInferenceConfig
+    input_norm_src = "attention_layernorm"
+    post_norm_src = "feedforward_layernorm"
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        return spec_from_config(
+            config, tp_degree,
+            qk_norm=True,
+            mlp_glu=False,
+            act="xielu",
+            qkv_bias=bool(getattr(config, "attention_bias", False)),
+            o_bias=bool(getattr(config, "attention_bias", False)),
+        )
+
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec):
+        p = cls.hf_prefix
+        return {
+            # plain-MLP slots: gate_proj/down_proj hold fc1/fc2
+            "gate_proj": layer_stack(p + ".layers.{i}.mlp.up_proj.weight",
+                                     _t),
+            "down_proj": layer_stack(p + ".layers.{i}.mlp.down_proj.weight",
+                                     _t),
+        }
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec):
+        p = "model.layers.{i}.mlp.act_fn."
+
+        def scalar(name):
+            def tr(i):
+                return np.float32(np.asarray(get(p.format(i=i) + name))
+                                  .reshape(-1)[0])
+            return tr
+
+        xi = np.stack([
+            np.array([scalar("alpha_p")(i), scalar("alpha_n")(i),
+                      scalar("beta")(i), scalar("eps")(i)], np.float32)
+            for i in range(spec.num_layers)])
+        return {"xielu": xi}
+
+
+@register_family("phimoe")
+class PhimoeFamily(DecoderFamily):
+    """Phi-3.5-MoE (reference: contrib/models/Phi-3.5-MoE-instruct):
+    mixtral-shaped 16-expert top-2 MoE with the sparsemixer inference
+    routing, LayerNorm (with bias) norms, and an optional lm-head bias."""
+
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        moe = MoESpec(
+            num_experts=config.num_local_experts,
+            top_k=config.num_experts_per_tok,
+            intermediate_size=config.intermediate_size,
+            normalize_topk=False,
+            router_act="sparsemixer",
+            sparsemixer_eps=float(getattr(config, "router_jitter_noise",
+                                          0.01)),
+            act=getattr(config, "hidden_act", "silu"),
+        )
+        bias = bool(getattr(config, "attention_bias", False))
+        window = getattr(config, "sliding_window", None) or 0
+        return spec_from_config(
+            config, tp_degree, moe=moe,
+            norm_type="layernorm", norm_bias=True,
+            qkv_bias=bias, o_bias=bias,
+            lm_head_bias=bool(getattr(config, "lm_head_bias", False)),
+            sliding_window=int(window),
+        )
+
+    @classmethod
+    def convert_mlp_weights(cls, get, layer_stack, spec):
+        p = cls.hf_prefix
+        return cls.convert_moe_weights(
+            get, spec,
+            router_name=p + ".layers.{i}.block_sparse_moe.gate.weight",
+            expert_fmt=(p + ".layers.{i}.block_sparse_moe.experts.{e}."
+                        "{name}.weight"),
+            gate="w1", up="w3", down="w2")
+
+    @classmethod
+    def convert_extra_layer_weights(cls, get, layer_stack, spec):
+        p = cls.hf_prefix
+        return {
+            "input_norm_b": layer_stack(
+                p + ".layers.{i}.input_layernorm.bias", _ident),
+            "post_norm_b": layer_stack(
+                p + ".layers.{i}.post_attention_layernorm.bias", _ident),
+        }
+
+    @classmethod
+    def convert_hf_state_dict(cls, sd, spec):
+        out = super().convert_hf_state_dict(sd, spec)
+        out["final_norm_b"] = np.asarray(sd["model.norm.bias"])
+        if spec.lm_head_bias and "lm_head.bias" in sd:
+            b = np.asarray(sd["lm_head.bias"])
+            if b.shape[0] < spec.padded_vocab:
+                b = np.pad(b, (0, spec.padded_vocab - b.shape[0]))
+            out["lm_head_b"] = b
+        return out
+
+    @classmethod
+    def load_hf_model(cls, model_path: str):
+        from transformers.models.phimoe import PhimoeForCausalLM
+        return PhimoeForCausalLM.from_pretrained(model_path)

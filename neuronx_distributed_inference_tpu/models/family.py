@@ -54,6 +54,9 @@ class DecoderFamily:
     # HF weight name feeding the pre-MLP norm ("post_norm" in the spec);
     # sandwich-norm families (gemma3) point it at pre_feedforward_layernorm
     post_norm_src = "post_attention_layernorm"
+    # HF weight name feeding the pre-attention norm (apertus uses
+    # "attention_layernorm")
+    input_norm_src = "input_layernorm"
     # HF attention output-projection module name (phi uses "dense")
     attn_o_src = "self_attn.o_proj"
 
@@ -103,7 +106,8 @@ class DecoderFamily:
             return np.asarray(w)
 
         layers = {
-            "input_norm": layer_stack(p + ".layers.{i}.input_layernorm.weight", ident),
+            "input_norm": layer_stack(
+                p + ".layers.{i}." + cls.input_norm_src + ".weight", ident),
             "q_proj": layer_stack(p + ".layers.{i}.self_attn.q_proj.weight", q_t),
             "k_proj": layer_stack(p + ".layers.{i}.self_attn.k_proj.weight", kv_t),
             "v_proj": layer_stack(p + ".layers.{i}.self_attn.v_proj.weight", kv_t),

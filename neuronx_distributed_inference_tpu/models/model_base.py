@@ -45,6 +45,7 @@ from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
 from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
+from ..modules import ssm as ssm_mod
 from ..modules.moe import MoESpec, moe_block
 from ..modules.lora import (LoraSpec, apply_lora, lora_spec_from_config)
 from ..modules.quantization import (QuantSpec, qlinear,
@@ -196,6 +197,9 @@ class DecoderSpec:
     first_dense: int = 0
     # "rms" | "layernorm" (dbrx uses bias-free LayerNorm)
     norm_type: str = "rms"
+    # no final pre-lm-head norm (GPT-1: the post-LN blocks already end
+    # normed; reference: contrib/models/openai-gpt)
+    skip_final_norm: bool = False
     # LayerNorm with learned bias (gpt2/falcon/starcoder2/phi/neox)
     norm_bias: bool = False
     # GLU MLP (act(gate)*up @ down, llama-shaped) vs plain 2-layer MLP
@@ -259,6 +263,47 @@ class DecoderSpec:
     # rescaled on read (reference: kv_cache_manager.py:636-692 scaled fp8
     # mode; None = direct cast)
     kv_scale: Optional[float] = None
+    # --- recurrent / hybrid state axis (reference: contrib/models/
+    # Falcon-H1-0.5B-Instruct hybrid attention+mamba2 and contrib/models/
+    # recurrentgemma-2b-it Griffin blocks — a SECOND cache pytree of
+    # conv tails + recurrent states carried next to the KV cache) ---
+    ssm: Optional[ssm_mod.SSMSpec] = None
+    # per-layer flag: True = layer i carries the SSM block; None with ssm
+    # set = every layer. With ssm_parallel the SSM runs NEXT TO attention
+    # inside each flagged layer (falcon-h1 parallel hybrid); otherwise it
+    # REPLACES attention there (recurrentgemma rec/rec/attn pattern).
+    ssm_pattern: Optional[Tuple[bool, ...]] = None
+    ssm_parallel: bool = False
+    # family-specific static constants that conversion / layer hooks need
+    # (falcon-h1 MuP multipliers) — a hashable (name, value) tuple so the
+    # spec stays jit-static
+    extras: Optional[Tuple[Tuple[str, Any], ...]] = None
+
+    def extra(self, name: str, default=None):
+        for k, v in (self.extras or ()):
+            if k == name:
+                return v
+        return default
+
+    @property
+    def resolved_ssm_pattern(self) -> Optional[Tuple[bool, ...]]:
+        if self.ssm is None:
+            return None
+        return (self.ssm_pattern if self.ssm_pattern is not None
+                else (True,) * self.num_layers)
+
+    @property
+    def num_attn_layers(self) -> int:
+        """Layers that read/write the KV cache (SSM-only layers don't)."""
+        pat = self.resolved_ssm_pattern
+        if pat is None or self.ssm_parallel:
+            return self.num_layers
+        return self.num_layers - sum(pat)
+
+    @property
+    def num_ssm_layers(self) -> int:
+        pat = self.resolved_ssm_pattern
+        return 0 if pat is None else sum(pat)
 
     @property
     def scale(self) -> float:
@@ -394,6 +439,9 @@ def _dense_mlp_param_specs(spec: DecoderSpec, L: int) -> Dict[str, ParamSpec]:
         if spec.mlp_glu:
             layers["up_bias"] = ParamSpec((L, I), P(None, AXIS_MP), dt,
                                           "zeros")
+    if spec.act == "xielu":
+        # [alpha_p_raw, alpha_n_raw, beta, eps] per layer (apertus)
+        layers["xielu"] = ParamSpec((L, 4), P(), jnp.float32, "ones")
     if spec.lora is not None:
         dims = {"gate_proj": (H, I), "down_proj": (I, H)}
         if spec.mlp_glu:
@@ -444,10 +492,11 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
         "embed": (vocab_parallel_embedding(spec.padded_vocab, H, dt)
                   if spec.vocab_parallel
                   else ParamSpec((spec.padded_vocab, H), P(), dt)),
-        "final_norm": ParamSpec((H,), P(), dt, "ones"),
     }
-    if spec.norm_bias:
-        out["final_norm_b"] = ParamSpec((H,), P(), dt, "zeros")
+    if not spec.skip_final_norm:
+        out["final_norm"] = ParamSpec((H,), P(), dt, "ones")
+        if spec.norm_bias:
+            out["final_norm_b"] = ParamSpec((H,), P(), dt, "zeros")
     if spec.learned_pos:
         out["pos_embed"] = ParamSpec((spec.learned_pos, H), P(), dt)
     if spec.embed_norm:
@@ -473,10 +522,32 @@ def decoder_param_specs(spec: DecoderSpec) -> Dict[str, Any]:
             dense = _attn_param_specs(spec, n_dense)
             dense.update(_dense_mlp_param_specs(spec, n_dense))
             out["layers"] = dense
+    elif spec.ssm is not None and not spec.ssm_parallel:
+        # interleaved recurrent/attention stacks (recurrentgemma): "layers"
+        # holds every layer's norms + MLP; attention weights stack over the
+        # attention layers only ("attn_layers"), SSM weights over the
+        # recurrent layers ("ssm_layers") — SSM-only layers carry no dead
+        # attention params and no KV cache rows
+        norm_keys = ("input_norm", "post_norm", "input_norm_b", "post_norm_b")
+        full = _attn_param_specs(spec, L)
+        shared = {k: v for k, v in full.items() if k in norm_keys}
+        shared.update(_dense_mlp_param_specs(spec, L))
+        out["layers"] = shared
+        if spec.num_attn_layers:
+            attn_full = _attn_param_specs(spec, spec.num_attn_layers)
+            out["attn_layers"] = {k: v for k, v in attn_full.items()
+                                  if k not in norm_keys}
+        if spec.num_ssm_layers:
+            out["ssm_layers"] = ssm_mod.ssm_param_specs(
+                spec.ssm, H, spec.num_ssm_layers, dt)
     else:
         layers = _attn_param_specs(spec, L)
         layers.update(_dense_mlp_param_specs(spec, L) if spec.moe is None
                       else _moe_param_specs(spec, L))
+        if spec.ssm is not None:
+            # parallel hybrid (falcon-h1): every layer is uniform — the SSM
+            # weights join the single "layers" stack
+            layers.update(ssm_mod.ssm_param_specs(spec.ssm, H, L, dt))
         out["layers"] = layers
     if not spec.tie_word_embeddings:
         out["lm_head"] = ParamSpec((H, spec.padded_vocab), P(None, AXIS_MP), dt)
@@ -698,7 +769,8 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     sp_axis = AXIS_CP if (spec.seq_parallel and phase == "prefill") else None
 
     def _mlp(x_in):
-        return _mlp_block(spec, x_in, layer_w, mlp_kind, adapter_ids)
+        return _mlp_block(spec, x_in, layer_w, mlp_kind, adapter_ids,
+                          phase=phase)
 
     if spec.block_style != "sequential":
         # parallel residual: x + attn(norm(x)) + mlp(norm'(x)) (falcon
@@ -711,6 +783,21 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
         hidden = hidden + spec.residual_multiplier * _shard(
             h + m, AXIS_DP, sp_axis, None)
         hidden = _deepstack_add(hidden, deepstack, deepstack_mask)
+        hidden = _tap("layer_output", hidden)
+        if side is not None:
+            return hidden, k_full, v_full, caps, pending
+        return hidden, k_full, v_full, caps
+
+    if spec.norm_position == "post_residual":
+        # original-transformer post-LN (openai-gpt / GPT-1: x = ln(x + sub(x))
+        # — reference: contrib/models/openai-gpt)
+        hidden = _norm(spec, hidden + _shard(h, AXIS_DP, sp_axis, None),
+                       layer_w["input_norm"],
+                       layer_w.get("input_norm_b") if spec.norm_bias else None)
+        h = _tap("mlp_output", _mlp(hidden))
+        hidden = _norm(spec, hidden + _shard(h, AXIS_DP, sp_axis, None),
+                       layer_w["post_norm"],
+                       layer_w.get("post_norm_b") if spec.norm_bias else None)
         hidden = _tap("layer_output", hidden)
         if side is not None:
             return hidden, k_full, v_full, caps, pending
@@ -734,11 +821,29 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     return hidden, k_full, v_full, caps
 
 
-def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids):
+def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids,
+               phase: str = "prefill"):
     """The MLP / MoE half of a layer (GLU, plain 2-layer, or routed MoE)."""
     if mlp_kind == "moe":
-        return moe_block(spec.moe, x_in, layer_w)
-    act = ACT_FNS[spec.act]
+        return moe_block(spec.moe, x_in, layer_w, phase=phase)
+    if spec.act == "xielu":
+        # Apertus xIELU with LEARNED per-layer alphas (reference:
+        # contrib/models/Apertus-8B-Instruct-2509; HF XIELUActivation):
+        # layer_w["xielu"] = [alpha_p_raw, alpha_n_raw, beta, eps]
+        xp = layer_w["xielu"].astype(jnp.float32)
+        alpha_p = jax.nn.softplus(xp[0])
+        beta, eps = xp[2], xp[3]
+        alpha_n = beta + jax.nn.softplus(xp[1])
+
+        def act(x):
+            xf = x.astype(jnp.float32)
+            y = jnp.where(
+                xf > 0,
+                alpha_p * xf * xf + beta * xf,
+                (jnp.expm1(jnp.minimum(xf, eps)) - xf) * alpha_n + beta * xf)
+            return y.astype(x.dtype)
+    else:
+        act = ACT_FNS[spec.act]
     if not spec.mlp_glu:
         # plain 2-layer MLP (gpt2/falcon/starcoder2/phi/neox):
         # gate_proj/down_proj slots hold fc1/fc2
@@ -900,7 +1005,9 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
                        and not spec.alibi
                        and spec.decode_kernel is not False
                        and decode_attention.supports(spec, 1)
-                       and spec.kv_scale is None and k_full.dtype == dtype)
+                       and (k_full.dtype == dtype
+                            or decode_attention.quantized_cache_ok(
+                                k_full.dtype.name)))
         if use_pkernel:
             if spec.layer_pattern is not None:
                 win = jnp.where(is_local, spec.sliding_window, 0)
@@ -910,6 +1017,7 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
                 q[:, 0], k_full, v_full, k[:, 0], v[:, 0], li,
                 positions[:, 0], block_table, scale=spec.scale, window=win,
                 soft_cap=spec.attn_soft_cap, sink=sink,
+                kv_scale=spec.kv_scale,
                 interpret=jax.default_backend() != "tpu")
             if kernel_out is None:
                 use_pkernel = False
@@ -1004,7 +1112,9 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
                       and not spec.rolling_window
                       and identity_seq_ids
                       and h.shape[0] == k_full.shape[1]
-                      and spec.kv_scale is None and k_full.dtype == dtype
+                      and (k_full.dtype == dtype
+                           or decode_attention.quantized_cache_ok(
+                               k_full.dtype.name))
                       and not spec.flash_decoding)
         if use_kernel and spec.decode_kernel is None:
             # auto admission (reference analog: flash-strategy heuristics,
@@ -1031,6 +1141,7 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
                 q[:, 0], k_full, v_full, k[:, 0], v[:, 0], li,
                 positions[:, 0], scale=spec.scale, window=win,
                 soft_cap=spec.attn_soft_cap, sink=sink,
+                kv_scale=spec.kv_scale,
                 interpret=jax.default_backend() != "tpu")
             if kernel_out is None:        # heads not shardable on this mesh
                 use_kernel = False
@@ -1134,6 +1245,17 @@ def run_layers(spec: DecoderSpec, params, cache, hidden, ai,
     Returns (hidden, new_cache, captured[, side]) — captured = {} unless
     spec.capture names per-layer points (then each is stacked (L, ...)).
     """
+    if spec.ssm is not None:
+        if any(x is not None for x in (slot_mapping, block_table,
+                                       replacements, deepstack, side)):
+            raise NotImplementedError(
+                "recurrent/hybrid stacks support the contiguous prefill + "
+                "decode paths only (no paged layout, tensor replacement, "
+                "deepstack, or chunked side-buffer decode)")
+        return run_layers_ssm(
+            spec, params, cache, hidden, ai, seq_ids, positions, phase,
+            identity_seq_ids=identity_seq_ids, adapter_ids=adapter_ids,
+            kv_view=kv_view, prefill_lens=prefill_lens)
     is_local = jnp.asarray(spec.layer_pattern if spec.layer_pattern is not None
                            else (False,) * spec.num_layers)
     rep = replacements or {}
@@ -1305,13 +1427,86 @@ def run_layer_slice(spec: DecoderSpec, layer_params, kf, vf, hidden, ai, *,
     return hidden, kf, vf, caps
 
 
+def run_layers_ssm(spec: DecoderSpec, params, cache, hidden, ai,
+                   seq_ids, positions, phase: str, *,
+                   identity_seq_ids=False, adapter_ids=None, kv_view=None,
+                   prefill_lens=None):
+    """Unrolled layer walk for recurrent/hybrid stacks (reference:
+    contrib Falcon-H1 FalconH1DecoderLayer — parallel mamba+attention;
+    contrib recurrentgemma RecurrentGemmaDecoderLayer — rec/rec/attn
+    pattern). The KV cache covers only the attention-bearing layers; the
+    recurrent state rides the same cache dict as stacked conv tails +
+    SSM states, updated with static per-layer indices.
+
+    Every layer shares the sequential residual shape: pre-norm temporal
+    block(s) → residual add → pre-norm MLP → residual add; the temporal
+    block is attention, the SSM, or (parallel hybrid) their sum.
+    """
+    s = spec.ssm
+    pat = spec.resolved_ssm_pattern
+    if phase not in ("prefill", "decode"):
+        raise NotImplementedError(
+            f"recurrent stacks do not support the {phase!r} phase")
+    if phase == "decode" and hidden.shape[1] != 1:
+        raise NotImplementedError(
+            "recurrent stacks decode one token per step (no speculation "
+            "windows / multi-token verify)")
+    kf, vf = cache["k"], cache["v"]
+    state_keys = [k for k in ("conv_x", "conv_bc", "ssm") if k in cache]
+    new_state = {k: cache[k] for k in state_keys}
+    not_local = jnp.asarray(False)
+    attn_i = 0
+    ssm_i = 0
+    for i in range(spec.num_layers):
+        has_ssm = bool(pat[i])
+        has_attn = spec.ssm_parallel or not has_ssm
+        lw = jax.tree.map(lambda a: a[i], params["layers"])
+        if has_attn and "attn_layers" in params:
+            ja = attn_i
+            lw = {**lw, **jax.tree.map(lambda a: a[ja], params["attn_layers"])}
+        if has_ssm and "ssm_layers" in params:
+            js = ssm_i
+            lw = {**lw, **jax.tree.map(lambda a: a[js], params["ssm_layers"])}
+        h = _norm(spec, hidden, lw["input_norm"],
+                  lw.get("input_norm_b") if spec.norm_bias else None)
+        t_out = None
+        if has_attn:
+            a_out, kf, vf, _ = _attn_block(
+                spec, h, lw, kf, vf, attn_i, ai, not_local, seq_ids,
+                positions, phase, identity_seq_ids=identity_seq_ids,
+                arange_positions=(phase == "prefill"),
+                adapter_ids=adapter_ids, kv_view=kv_view,
+                prefill_lens=prefill_lens)
+            t_out = a_out
+            attn_i += 1
+        if has_ssm:
+            st = {k: new_state[k][ssm_i] for k in state_keys}
+            s_out, st_new = ssm_mod.ssm_block(
+                s, lw, h, st, phase=phase, seq_lens=prefill_lens,
+                positions=positions)
+            for k2, v2 in st_new.items():
+                new_state[k2] = new_state[k2].at[ssm_i].set(
+                    v2.astype(new_state[k2].dtype))
+            t_out = s_out if t_out is None else t_out + s_out
+            ssm_i += 1
+        hidden = hidden + _shard(t_out, AXIS_DP, None, None)
+        h2 = _norm(spec, hidden, lw["post_norm"],
+                   lw.get("post_norm_b") if spec.norm_bias else None)
+        hidden = hidden + _shard(
+            _mlp_block(spec, h2, lw, "dense", adapter_ids),
+            AXIS_DP, None, None)
+    return hidden, {"k": kf, "v": vf, **new_state}, {}
+
+
 def run_layers_mixed_decode(spec: DecoderSpec, params, cache, hidden, ai,
                             seq_ids, positions, kv_view=None,
-                            adapter_ids=None):
+                            adapter_ids=None, identity_seq_ids=True):
     """Decode layer loop over the MIXED cache (reference: gpt-oss per-layer
     KV sizes, modules/kvcache/gpt_oss_kv_cache_manager.py): local layers
     read/write the rolling {"k_l","v_l"} stacks (W slots), global layers
-    the full {"k","v"} stacks — selected statically per unrolled layer."""
+    the full {"k","v"} stacks — selected statically per unrolled layer.
+    identity_seq_ids=False (continuous-batching serving): reads gather and
+    writes scatter through seq_ids on both stack kinds."""
     lmap = kv.mixed_layer_map(spec.layer_pattern)
     kf, vf = cache["k"], cache["v"]
     kl, vl = cache["k_l"], cache["v_l"]
@@ -1323,13 +1518,13 @@ def run_layers_mixed_decode(spec: DecoderSpec, params, cache, hidden, ai,
             hidden, kl, vl, caps_i = _layer_body(
                 spec, hidden, layer_w, kl, vl, lmap[i], ai,
                 jnp.asarray(True), seq_ids, positions, "decode",
-                identity_seq_ids=True, adapter_ids=adapter_ids,
+                identity_seq_ids=identity_seq_ids, adapter_ids=adapter_ids,
                 mixed_local=True)
         else:
             hidden, kf, vf, caps_i = _layer_body(
                 spec, hidden, layer_w, kf, vf, lmap[i], ai,
                 jnp.asarray(False), seq_ids, positions, "decode",
-                identity_seq_ids=True, adapter_ids=adapter_ids,
+                identity_seq_ids=identity_seq_ids, adapter_ids=adapter_ids,
                 kv_view=kv_view, mixed_local=False)
         caps_list.append(caps_i)
     caps = ({k2: jnp.stack([c[k2] for c in caps_list])
@@ -1337,31 +1532,44 @@ def run_layers_mixed_decode(spec: DecoderSpec, params, cache, hidden, ai,
     return hidden, {"k": kf, "v": vf, "k_l": kl, "v_l": vl}, caps
 
 
-def fold_mixed_prefill(spec: DecoderSpec, scratch_cache, cache, seq_lens):
+def fold_mixed_prefill(spec: DecoderSpec, scratch_cache, cache, seq_lens,
+                       seq_ids=None):
     """Mixed-cache prefill epilogue: copy the scratch full-length rows of
     GLOBAL layers into the persistent full stacks and FOLD local layers'
-    rows into the rolling stacks (reference: gpt-oss manager CTE path)."""
+    rows into the rolling stacks (reference: gpt-oss manager CTE path).
+    seq_ids (b,) — continuous-batching target rows; None = rows [0, b)."""
     pat = spec.layer_pattern
     g_idx = [i for i, x in enumerate(pat) if not x]
     l_idx = [i for i, x in enumerate(pat) if x]
     gi = jnp.asarray(g_idx, jnp.int32)
     li = jnp.asarray(l_idx, jnp.int32)
     W = cache["k_l"].shape[4]
+    kl_fold = kv.fold_rolling_prefill(
+        scratch_cache["k"][li], seq_lens, W, k_transposed=True)
+    vl_fold = kv.fold_rolling_prefill(scratch_cache["v"][li], seq_lens, W)
     new = dict(cache)
+    if seq_ids is not None:
+        # continuous batching: scatter the prefilled rows at their cache
+        # slots; the scratch covers only the ctx-bucket slots [0, sb)
+        # (reference: single-seq CTE update, kv_cache_manager.py:483)
+        sb = scratch_cache["k"].shape[4]
+        new["k"] = cache["k"].at[:, seq_ids, :, :, :sb].set(
+            scratch_cache["k"][gi])
+        new["v"] = cache["v"].at[:, seq_ids, :, :sb, :].set(
+            scratch_cache["v"][gi])
+        new["k_l"] = cache["k_l"].at[:, seq_ids].set(kl_fold)
+        new["v_l"] = cache["v_l"].at[:, seq_ids].set(vl_fold)
+        return new
     new["k"] = jax.lax.dynamic_update_slice(
         cache["k"], scratch_cache["k"][gi], (0, 0, 0, 0, 0))
     new["v"] = jax.lax.dynamic_update_slice(
         cache["v"], scratch_cache["v"][gi], (0, 0, 0, 0, 0))
     # partial-batch prefill (2-D batch buckets): update rows [0, b) in
     # place — replacing the stacks would change the cache pytree shape
-    new["k_l"] = jax.lax.dynamic_update_slice(
-        cache["k_l"], kv.fold_rolling_prefill(
-            scratch_cache["k"][li], seq_lens, W, k_transposed=True),
-        (0, 0, 0, 0, 0))
-    new["v_l"] = jax.lax.dynamic_update_slice(
-        cache["v_l"], kv.fold_rolling_prefill(
-            scratch_cache["v"][li], seq_lens, W),
-        (0, 0, 0, 0, 0))
+    new["k_l"] = jax.lax.dynamic_update_slice(cache["k_l"], kl_fold,
+                                              (0, 0, 0, 0, 0))
+    new["v_l"] = jax.lax.dynamic_update_slice(cache["v_l"], vl_fold,
+                                              (0, 0, 0, 0, 0))
     return new
 
 
@@ -1385,7 +1593,8 @@ def _embed(spec: DecoderSpec, params, input_ids, position_ids=None):
 
 
 def _lm_head(spec: DecoderSpec, params, hidden):
-    h = _norm(spec, hidden, params["final_norm"], params.get("final_norm_b"))
+    h = (hidden if spec.skip_final_norm else
+         _norm(spec, hidden, params["final_norm"], params.get("final_norm_b")))
     w = params["embed"].T if spec.tie_word_embeddings else params["lm_head"]
     logits = (h @ w).astype(jnp.float32)
     if spec.lm_head_bias and "lm_head_b" in params:
@@ -1443,10 +1652,12 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
             deepstack_embeds.astype(hidden.dtype),
             ((0, pad_l), (0, 0), (0, 0), (0, 0)))
     persistent = cache
+    identity = not tpu_cfg.is_continuous_batching
     if spec.mixed_kv:
         # mixed per-layer cache: prefill runs on a full-length SCRATCH for
-        # every layer; the epilogue folds local layers into the rolling
-        # stacks (reference: gpt_oss_kv_cache_manager.py CTE path)
+        # every layer (identity rows — the fold scatters to the real rows);
+        # the epilogue folds local layers into the rolling stacks
+        # (reference: gpt_oss_kv_cache_manager.py CTE path)
         b, sb = input_ids.shape
         g = spec.gqa
         kdt = cache["k"].dtype
@@ -1454,14 +1665,17 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                                  spec.head_dim, sb), kdt),
                  "v": jnp.zeros((spec.num_layers, b, g.num_kv_heads, sb,
                                  spec.v_head_dim), kdt)}
+        identity = True
     hidden, new_cache, caps = run_layers(
         spec, params, cache, hidden, ai, seq_ids, position_ids, "prefill",
-        identity_seq_ids=not tpu_cfg.is_continuous_batching,
+        identity_seq_ids=identity,
         arange_positions=True, adapter_ids=adapter_ids,
         replacements=replacements, deepstack=deepstack_embeds,
         deepstack_mask=image_mask, prefill_lens=seq_lens)
     if spec.mixed_kv:
-        new_cache = fold_mixed_prefill(spec, new_cache, persistent, seq_lens)
+        new_cache = fold_mixed_prefill(
+            spec, new_cache, persistent, seq_lens,
+            seq_ids=None if not tpu_cfg.is_continuous_batching else seq_ids)
     # last-token gather (reference: lm-head index + logit padding mask :987-999)
     idx = jnp.maximum(seq_lens - 1, 0)
     last_h = jnp.take_along_axis(hidden, idx[:, None, None].astype(jnp.int32), axis=1)
@@ -1519,7 +1733,8 @@ def token_generation_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
             position_ids, cache["k_l"].shape[4])
         hidden, new_cache, caps = run_layers_mixed_decode(
             spec, params, cache, hidden, ai, seq_ids, position_ids,
-            kv_view=kv_view, adapter_ids=adapter_ids)
+            kv_view=kv_view, adapter_ids=adapter_ids,
+            identity_seq_ids=not tpu_cfg.is_continuous_batching)
     else:
         hidden, new_cache, caps = run_layers(
             spec, params, cache, hidden, ai, seq_ids, position_ids,
@@ -1548,6 +1763,10 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
         raise NotImplementedError(
             "multi-token decode over the mixed per-layer cache is not "
             "supported; disable speculation or set mixed_kv=False")
+    if spec.ssm is not None:
+        raise NotImplementedError(
+            "multi-token decode (speculation verify / windowed CTE) is not "
+            "supported on recurrent/hybrid stacks")
     cache_len = kv.cache_len_of(cache)
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
         position_ids, cache_len, window=w, chunk=c))
@@ -1625,6 +1844,7 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                  and b == cache["k"].shape[1]
                  and not spec.rolling_window
                  and not spec.flash_decoding
+                 and spec.ssm is None
                  and spec.decode_kernel is not True
                  and not spec.alibi
                  and not (spec.attn_sink or spec.sliding_window > 0
@@ -1853,6 +2073,49 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
     )
     kw.update(overrides)
+    if kw.get("moe") is not None:
+        mc = tcfg.moe_config
+        tkg_ep = getattr(mc, "moe_tkg_ep_degree", None)
+        for knob in ("moe_cte_tp_degree", "moe_cte_ep_degree",
+                     "moe_tkg_tp_degree"):
+            v = getattr(mc, knob, None)
+            if v is not None:
+                raise NotImplementedError(
+                    f"{knob}={v}: under GSPMD the mesh fixes the CTE expert "
+                    "layout and the TKG tp extent; only moe_tkg_ep_degree=1 "
+                    "(all-experts-local decode) reshards per phase")
+        if tkg_ep is not None:
+            if tkg_ep != 1:
+                raise NotImplementedError(
+                    "hybrid MoE sharding supports moe_tkg_ep_degree=1 "
+                    "(all-experts-local decode) only; the mesh fixes other "
+                    "degree combinations")
+            kw["moe"] = replace(kw["moe"], tkg_experts_local=True)
+    if kw.get("ssm") is not None:
+        sc = tcfg.speculation_config
+        bad = []
+        if tcfg.is_block_kv_layout:
+            bad.append("paged KV layout")
+        if tcfg.flash_decoding_enabled:
+            bad.append("flash decoding")
+        if tcfg.is_continuous_batching:
+            bad.append("continuous batching")
+        if tcfg.sequence_parallel_enabled:
+            bad.append("sequence parallelism")
+        if tcfg.windowed_context_encoding:
+            bad.append("windowed context encoding")
+        if sc and (sc.speculation_length or sc.medusa_speculation_length):
+            bad.append("speculation")
+        if tcfg.tensor_capture_config or tcfg.tensor_replacement_config:
+            bad.append("tensor capture/replacement")
+        if bad:
+            raise NotImplementedError(
+                "recurrent/hybrid (SSM) stacks do not yet support: "
+                + ", ".join(bad))
+        # the recurrent state replaces long-range KV; keep the attention
+        # cache simple (full rows, no rolling/mixed layouts)
+        kw.setdefault("rolling_window", False)
+        kw.setdefault("mixed_kv", False)
     if "rolling_window" not in kw:
         roll = tcfg.rolling_kv_cache
         sc = tcfg.speculation_config
@@ -1891,7 +2154,6 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
             and not tcfg.windowed_context_encoding
             and not tcfg.is_block_kv_layout
             and not tcfg.flash_decoding_enabled
-            and not tcfg.is_continuous_batching
             and not (sc and (sc.speculation_length
                              or sc.medusa_speculation_length))
             and not (tcfg.tensor_capture_config

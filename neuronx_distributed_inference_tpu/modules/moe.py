@@ -47,7 +47,9 @@ class MoESpec:
     intermediate_size: int           # per-expert intermediate
     normalize_topk: bool = True      # renormalize top-k affinities
     routed_scaling: Optional[float] = None
-    router_act: str = "softmax"      # "softmax" | "sigmoid"
+    # "softmax" | "sigmoid" | "sparsemixer" (phimoe inference routing)
+    router_act: str = "softmax"
+    sparsemixer_eps: float = 0.01    # phimoe router_jitter_noise
     pre_softmax_topk: bool = False   # top-k on raw logits, then act over k
     shared_intermediate: int = 0     # 0 = no shared experts
     act: str = "silu"
@@ -80,6 +82,15 @@ class MoESpec:
     # all-experts path is used; above it the ragged sorted-grouped-matmul
     # path runs. Decode (B*1 tokens) stays dense up to batch 64 by default.
     dense_max_tokens: int = 64
+    # hybrid CTE/TKG expert sharding (reference: moe_v2.py:135-161
+    # HybridShardingConfig — moe_tkg_ep_degree=1): prefill keeps experts
+    # sharded on "ep" (token-parallel experts, all-to-all-free combine via
+    # psum); DECODE re-constrains the expert weights so every device holds
+    # ALL experts with the intermediate dim split over ("ep","tp") — the
+    # all-gather of the weights is loop-invariant, so XLA hoists it out of
+    # the fused decode scan (the GSPMD analog of the reference's
+    # relayout-once-at-load into the TKG process group)
+    tkg_experts_local: bool = False
 
 
 def _act_fn(name: str):
@@ -97,6 +108,8 @@ def route(moe: MoESpec, h: jnp.ndarray, router_w: jnp.ndarray,
     MoENeuronConfig (normalize_top_k_affinities, routed_scaling_factor).
     """
     logits = h.astype(jnp.float32) @ router_w.astype(jnp.float32)  # (B,T,E)
+    if moe.router_act == "sparsemixer":
+        return _sparsemixer_route(moe, logits)
     if router_bias is not None and moe.router_bias_mode == "logits":
         logits = logits + router_bias
         router_bias = None
@@ -132,6 +145,43 @@ def route(moe: MoESpec, h: jnp.ndarray, router_w: jnp.ndarray,
     if moe.routed_scaling is not None:
         top_vals = top_vals * moe.routed_scaling
     return top_vals, top_idx
+
+
+def _sparsemixer_route(moe: MoESpec, logits: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Phi-3.5-MoE sparsemixer routing, inference path (reference:
+    contrib/models/Phi-3.5-MoE-instruct — HF modeling_phimoe.sparsemixer
+    eval branch): expert i is the argmax of the remaining scores; its
+    affinity is a softmax over the scores with entries masked out where
+    (max - s) / max(|s|, max) > 2·jitter_eps. top_k must be 2."""
+    if moe.top_k != 2:
+        raise NotImplementedError(
+            f"sparsemixer routing is defined for top_k=2 (got {moe.top_k})")
+    eps = moe.sparsemixer_eps
+
+    def pick(scores):
+        mx = jnp.max(scores, axis=-1, keepdims=True)
+        factor = jnp.maximum(jnp.abs(logits), mx)
+        masked = jnp.where((mx - logits) / factor > 2 * eps, -jnp.inf, scores)
+        idx = jnp.argmax(scores, axis=-1)
+        gates = jax.nn.softmax(masked, axis=-1)
+        val = jnp.take_along_axis(gates, idx[..., None], axis=-1)
+        return val[..., 0], idx
+
+    v1, i1 = pick(logits)
+    masked_scores = jnp.where(
+        jax.nn.one_hot(i1, logits.shape[-1], dtype=bool), -jnp.inf, logits)
+    # second pass: threshold is measured against the REMAINING max but
+    # factor still uses the original logits (HF keeps `scores.abs()`)
+    mx2 = jnp.max(masked_scores, axis=-1, keepdims=True)
+    factor2 = jnp.maximum(jnp.abs(logits), mx2)
+    masked2 = jnp.where((mx2 - logits) / factor2 > 2 * eps, -jnp.inf,
+                        masked_scores)
+    i2 = jnp.argmax(masked_scores, axis=-1)
+    g2 = jax.nn.softmax(masked2, axis=-1)
+    v2 = jnp.take_along_axis(g2, i2[..., None], axis=-1)[..., 0]
+    return (jnp.stack([v1, v2], axis=-1),
+            jnp.stack([i1, i2], axis=-1).astype(jnp.int32))
 
 
 def combine_matrix(num_experts: int, top_vals: jnp.ndarray,
@@ -230,8 +280,8 @@ def experts_ragged(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     return y.astype(dt)
 
 
-def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any]
-              ) -> jnp.ndarray:
+def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any],
+              phase: str = "prefill") -> jnp.ndarray:
     """Full MoE block: route + experts (+ shared experts). x (B,T,H)."""
     router_bias = layer_w.get("router_bias") if moe.has_router_bias else None
     top_vals, top_idx = route(moe, x, layer_w["router"], router_bias)
@@ -240,8 +290,19 @@ def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any]
     biases = ((layer_w["expert_gate_bias"], layer_w["expert_up_bias"],
                layer_w["expert_down_bias"]) if moe.expert_bias
               else (None, None, None))
-    y = experts(moe, x, top_vals, top_idx, layer_w["expert_gate"],
-                layer_w["expert_up"], layer_w["expert_down"], *biases)
+    wg, wu, wd = (layer_w["expert_gate"], layer_w["expert_up"],
+                  layer_w["expert_down"])
+    if moe.tkg_experts_local and phase == "decode":
+        # hybrid TKG sharding: all experts local, intermediate split over
+        # BOTH model axes (see MoESpec.tkg_experts_local)
+        def recon(w, ps):
+            if is_quantized_leaf(w):
+                return w     # scale shapes vary; keep the stored layout
+            return shard_constraint(w, *ps)
+        wg = recon(wg, (None, None, (AXIS_EP, AXIS_TP)))
+        wu = recon(wu, (None, None, (AXIS_EP, AXIS_TP)))
+        wd = recon(wd, (None, (AXIS_EP, AXIS_TP), None))
+    y = experts(moe, x, top_vals, top_idx, wg, wu, wd, *biases)
     if moe.shared_intermediate > 0:
         act = _act_fn(moe.act)
         s = act(qlinear(x, layer_w["shared_gate"])) * qlinear(x, layer_w["shared_up"])

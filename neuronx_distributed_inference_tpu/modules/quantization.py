@@ -43,6 +43,9 @@ MXFP4 = "mxfp4"
 
 PER_TENSOR = "per_tensor_symmetric"
 PER_CHANNEL = "per_channel_symmetric"
+# row-blockwise: one scale per ``group_size`` contraction channels per output
+# channel (reference: the blockwise qconfigs of model_wrapper.py:1477-1528)
+BLOCKWISE = "blockwise_symmetric"
 
 # weights eligible for quantization inside a decoder layer stack; the
 # reference's modules_to_not_convert (models/config.py:233) subtracts from
@@ -66,9 +69,10 @@ _FP4_VALUES = np.array(
 class QuantSpec:
     """Static quantization description (hashable; closed over by jit).
 
-    dtype: "int8" | "fp8" | "mxfp4"; scheme per reference quantization_type.
-    group_size only applies to mxfp4 (scale per group along the contraction
-    dim). modules_to_not_convert: weight names left in full precision.
+    dtype: "int8" | "fp8" | "mxfp4"; scheme per reference quantization_type
+    (per-tensor / per-channel / blockwise). group_size applies to mxfp4 AND
+    the blockwise scheme (scale per group along the contraction dim).
+    modules_to_not_convert: weight names left in full precision.
     """
 
     dtype: str = INT8
@@ -115,7 +119,22 @@ def quantize_tensor(w: np.ndarray, spec: QuantSpec) -> Dict[str, np.ndarray]:
     w = np.asarray(w, dtype=np.float32)
     # leading dims (layer stack L, experts E) are never reduced: "per tensor"
     # means per (layer, expert) weight matrix, matching the reference's
-    # per-module qconfigs (model_wrapper.py:1477-1528)
+    # per-module qconfigs (model_wrapper.py:1477-1528) — which also makes
+    # per-tensor on stacked experts EXPERT-WISE scales for free
+    if spec.scheme == BLOCKWISE and spec.dtype in (INT8, FP8):
+        *lead, K, N = w.shape
+        G = spec.group_size
+        assert K % G == 0, (K, G)
+        g = w.reshape(*lead, K // G, G, N)
+        qmax = 127.0 if spec.dtype == INT8 else 448.0
+        scale = _absmax_scale(g, (len(lead) + 1,), qmax)    # (...,K//G,1,N)
+        scaled = g / scale
+        if spec.dtype == INT8:
+            q = np.clip(np.round(scaled), -127, 127).astype(np.int8)
+        else:
+            q = scaled.astype(jnp.float8_e4m3fn)
+        return {"qweight": q.reshape(*lead, K, N),
+                "scale": scale.reshape(*lead, K // G, N)}
     if spec.dtype == INT8:
         axis = ((w.ndim - 2, w.ndim - 1) if spec.scheme == PER_TENSOR
                 else (w.ndim - 2,))
@@ -159,10 +178,13 @@ def quantize_mxfp4(w: np.ndarray, group_size: int = 32) -> Dict[str, np.ndarray]
 
 
 def _leaf_scheme(leaf: Dict[str, Any]) -> str:
-    # uint8 = packed fp4 nibbles; int8 / float8_e4m3fn identify themselves
+    # uint8 = packed fp4 nibbles; int8 / float8_e4m3fn identify themselves;
+    # a >1 extent in the scale's contraction slot marks blockwise
     dt = leaf["qweight"].dtype
     if dt == jnp.uint8:
         return MXFP4
+    if leaf["scale"].ndim >= 2 and leaf["scale"].shape[-2] > 1:
+        return BLOCKWISE
     return FP8 if dt == jnp.float8_e4m3fn else INT8
 
 
@@ -210,6 +232,12 @@ def dequant_oai_mxfp4_blocks(blocks: np.ndarray, scales: np.ndarray
 def dequantize(leaf: Dict[str, Any], dtype=jnp.bfloat16) -> jnp.ndarray:
     """Materialize the fp weight (mxfp4 path; int8/fp8 prefer qlinear)."""
     q, scale = leaf["qweight"], leaf["scale"]
+    if _leaf_scheme(leaf) == BLOCKWISE:
+        *lead, K, N = q.shape
+        group = K // scale.shape[-2]
+        vals = q.astype(jnp.float32).reshape(*lead, K // group, group, N)
+        vals = vals * scale[..., :, None, :]
+        return vals.reshape(*lead, K, N).astype(dtype)
     if _leaf_scheme(leaf) == MXFP4:
         lut = jnp.asarray(_FP4_VALUES)
         lo = lut[(q & 0x0F).astype(jnp.int32)]
@@ -237,7 +265,10 @@ def qlinear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     if not is_quantized_leaf(w):
         return x @ w
     scheme = _leaf_scheme(w)
-    if scheme == MXFP4:
+    if scheme in (MXFP4, BLOCKWISE):
+        # blockwise scales don't commute out of the contraction; the weight
+        # still streams from HBM quantized — the dequant fuses into the
+        # matmul read (XLA), preserving the bandwidth win
         return x @ dequantize(w, x.dtype)
     q, scale = w["qweight"], w["scale"]
     y = x @ q.astype(x.dtype)
@@ -253,7 +284,7 @@ def qeinsum(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
     if not is_quantized_leaf(w):
         return jnp.einsum(pattern, x, w)
     scheme = _leaf_scheme(w)
-    if scheme == MXFP4:
+    if scheme in (MXFP4, BLOCKWISE):
         return jnp.einsum(pattern, x, dequantize(w, x.dtype))
     q, scale = w["qweight"], w["scale"]
     y = jnp.einsum(pattern, x, q.astype(x.dtype))

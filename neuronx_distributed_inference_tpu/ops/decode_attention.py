@@ -41,7 +41,8 @@ NEG_INF = -2.3819763e38
 def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
                    o_ref, acc_ref, m_ref, l_ref, *,
                    scale: float, block_s: int, nh: int,
-                   soft_cap: Optional[float], has_sink: bool):
+                   soft_cap: Optional[float], has_sink: bool,
+                   kv_scale: Optional[float] = None):
     """Scalar-prefetch layout: lens_ref = [layer_idx, window, len_0, ...,
     len_{B-1}] (layer_idx consumed by the index maps of the stacked-cache
     variant; window is DYNAMIC so alternating local/global layer patterns
@@ -78,6 +79,12 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
             q = q_ref[0, 0, hh].astype(jnp.float32)        # (G, D)
             k = k_ref[0, 0, hh].astype(jnp.float32)        # (D, bs) transposed
             v = v_ref[0, 0, hh].astype(jnp.float32)        # (bs, D)
+            if kv_scale is not None:
+                # scaled KV quantization: stored value = x / kv_scale
+                # (reference: kv_cache_manager.py:636-692 scaled fp8 mode);
+                # the dequant rides the fp32 cast already on the block load
+                k = k * kv_scale
+                v = v * kv_scale
             s = jax.lax.dot_general(q, k, (((1,), (0,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if soft_cap is not None:
@@ -123,13 +130,15 @@ def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "window", "soft_cap", "block_s", "interpret"))
+    static_argnames=("scale", "window", "soft_cap", "kv_scale", "block_s",
+                     "interpret"))
 def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      v_cache: jnp.ndarray, new_k: jnp.ndarray,
                      new_v: jnp.ndarray, lens: jnp.ndarray, *,
                      scale: float, window: int = 0,
                      soft_cap: Optional[float] = None,
                      sink: Optional[jnp.ndarray] = None,
+                     kv_scale: Optional[float] = None,
                      block_s: int = 256, interpret: bool = False
                      ) -> jnp.ndarray:
     """One-token decode attention over prior cache + active token.
@@ -144,12 +153,12 @@ def decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         q, k_cache[None], v_cache[None], new_k, new_v,
         jnp.zeros((), jnp.int32), lens, scale=scale,
         window=jnp.asarray(window, jnp.int32), soft_cap=soft_cap, sink=sink,
-        block_s=block_s, interpret=interpret)
+        kv_scale=kv_scale, block_s=block_s, interpret=interpret)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "soft_cap", "block_s", "interpret"))
+    static_argnames=("scale", "soft_cap", "kv_scale", "block_s", "interpret"))
 def decode_attention_stacked(q: jnp.ndarray, k_cache: jnp.ndarray,
                              v_cache: jnp.ndarray, new_k: jnp.ndarray,
                              new_v: jnp.ndarray, layer: jnp.ndarray,
@@ -158,6 +167,7 @@ def decode_attention_stacked(q: jnp.ndarray, k_cache: jnp.ndarray,
                              window: Optional[jnp.ndarray] = None,
                              soft_cap: Optional[float] = None,
                              sink: Optional[jnp.ndarray] = None,
+                             kv_scale: Optional[float] = None,
                              block_s: int = 256, interpret: bool = False
                              ) -> jnp.ndarray:
     """Decode attention reading layer ``layer`` (traced scalar — inside the
@@ -217,7 +227,7 @@ def decode_attention_stacked(q: jnp.ndarray, k_cache: jnp.ndarray,
     grid = (b, hb, nj)
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_s=block_s, nh=nh,
-        soft_cap=soft_cap, has_sink=sink is not None)
+        soft_cap=soft_cap, has_sink=sink is not None, kv_scale=kv_scale)
     if window is None:
         window = jnp.zeros((), jnp.int32)
     scalars = jnp.concatenate([
@@ -257,6 +267,7 @@ def dispatch(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
              window: Optional[jnp.ndarray] = None,
              soft_cap: Optional[float] = None,
              sink: Optional[jnp.ndarray] = None,
+             kv_scale: Optional[float] = None,
              block_s: int = 256, interpret: bool = False) -> jnp.ndarray:
     """Mesh-aware entry: shard_map the kernel over the ambient mesh's
     model-parallel axes (kv-heads over ("ep","tp")) and the decode batch
@@ -288,8 +299,8 @@ def dispatch(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     if not mp_axes and not dp_axes:
         return decode_attention_stacked(
             q, k_cache, v_cache, new_k, new_v, layer, lens, scale=scale,
-            window=window, soft_cap=soft_cap, sink=sink, block_s=block_s,
-            interpret=interpret)
+            window=window, soft_cap=soft_cap, sink=sink, kv_scale=kv_scale,
+            block_s=block_s, interpret=interpret)
 
     if window is None:
         window = jnp.zeros((), jnp.int32)
@@ -316,7 +327,7 @@ def dispatch(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
         return decode_attention_stacked(
             q, kc, vc, nk, nv, layer, lens, scale=scale, window=window,
             soft_cap=soft_cap, sink=rest[0] if rest else None,
-            block_s=block_s, interpret=interpret)
+            kv_scale=kv_scale, block_s=block_s, interpret=interpret)
 
     return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                          out_specs=P(dp, mpx, None), check_vma=False)(*args)
@@ -325,7 +336,8 @@ def dispatch(q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
 def _paged_kernel(sc_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
                   o_ref, acc_ref, m_ref, l_ref, *,
                   scale: float, block_s: int, nh: int,
-                  soft_cap: Optional[float], has_sink: bool):
+                  soft_cap: Optional[float], has_sink: bool,
+                  kv_scale: Optional[float] = None):
     """Ragged PAGED decode attention (reference: the DMA-skipping TKG
     attention over the block layout, attention_base.py:1186-1382 +
     block_kv_cache_manager.py:183-267). Scalar layout:
@@ -360,6 +372,11 @@ def _paged_kernel(sc_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
             q = q_ref[0, 0, hh].astype(jnp.float32)        # (G, D)
             k = k_ref[0, 0, :, hh, :].astype(jnp.float32)  # (bs, D)
             v = v_ref[0, 0, :, hh, :].astype(jnp.float32)  # (bs, D)
+            if kv_scale is not None:
+                # scaled KV dequant on the page load (reference:
+                # kv_cache_manager.py:636-692 scaled fp8 mode)
+                k = k * kv_scale
+                v = v * kv_scale
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32) * scale
             if soft_cap is not None:
@@ -402,7 +419,7 @@ def _paged_kernel(sc_ref, q_ref, k_ref, v_ref, nk_ref, nv_ref, sink_ref,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("scale", "soft_cap", "interpret"))
+    static_argnames=("scale", "soft_cap", "kv_scale", "interpret"))
 def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            v_pages: jnp.ndarray, new_k: jnp.ndarray,
                            new_v: jnp.ndarray, layer: jnp.ndarray,
@@ -411,6 +428,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
                            window: Optional[jnp.ndarray] = None,
                            soft_cap: Optional[float] = None,
                            sink: Optional[jnp.ndarray] = None,
+                           kv_scale: Optional[float] = None,
                            interpret: bool = False) -> jnp.ndarray:
     """Ragged paged decode attention over the stacked block cache.
 
@@ -463,7 +481,7 @@ def paged_decode_attention(q: jnp.ndarray, k_pages: jnp.ndarray,
 
     grid = (b, hb, mb)
     kernel = functools.partial(
-        _paged_kernel, scale=scale, block_s=bs, nh=nh,
+        _paged_kernel, scale=scale, block_s=bs, nh=nh, kv_scale=kv_scale,
         soft_cap=soft_cap, has_sink=sink is not None)
     if window is None:
         window = jnp.zeros((), jnp.int32)
@@ -506,6 +524,7 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
                    scale: float, window: Optional[jnp.ndarray] = None,
                    soft_cap: Optional[float] = None,
                    sink: Optional[jnp.ndarray] = None,
+                   kv_scale: Optional[float] = None,
                    interpret: bool = False) -> Optional[jnp.ndarray]:
     """Mesh-aware entry for the paged kernel: shard kv-heads over the
     model-parallel axes, matching the block-cache sharding
@@ -531,7 +550,7 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         return paged_decode_attention(
             q, k_pages, v_pages, new_k, new_v, layer, lens, block_table,
             scale=scale, window=window, soft_cap=soft_cap, sink=sink,
-            interpret=interpret)
+            kv_scale=kv_scale, interpret=interpret)
 
     if window is None:
         window = jnp.zeros((), jnp.int32)
@@ -559,7 +578,8 @@ def paged_dispatch(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
         return paged_decode_attention(
             q, kp, vp, nk, nv, layer, lens, table, scale=scale,
             window=window, soft_cap=soft_cap,
-            sink=rest[0] if rest else None, interpret=interpret)
+            sink=rest[0] if rest else None, kv_scale=kv_scale,
+            interpret=interpret)
 
     return jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
                          out_specs=P(dp, mpx, None), check_vma=False)(*args)
@@ -573,3 +593,42 @@ def supports(spec, phase_t: int) -> bool:
     chunked local layers take the XLA path)."""
     return (phase_t == 1 and spec.mla is None
             and spec.head_dim in (64, 128) and spec.attn_chunk == 0)
+
+
+@functools.lru_cache(maxsize=None)
+def quantized_cache_ok(cache_dtype_name: str) -> bool:
+    """Whether Mosaic on this backend can stream cache blocks of the given
+    (non-compute) dtype — fp8 KV caches (reference analog: the TKG kernel
+    running over the fp8 KV cache, kv_cache_manager.py:636-692). Probed
+    once with an AOT compile of a tiny kernel; CPU interpret always works."""
+    if cache_dtype_name in ("bfloat16", "float32", "float16"):
+        return True
+    if jax.default_backend() != "tpu":
+        return True          # tests run the interpret path
+    try:
+        sds = jax.ShapeDtypeStruct
+        dt = jnp.dtype(cache_dtype_name)
+        # probe BOTH kernels: q (B=1, Hq=4, D) over a 1-kv-head cache —
+        # new_k/new_v carry Hkv=1 like the cache
+        fn = functools.partial(decode_attention_stacked, scale=1.0,
+                               kv_scale=None)
+        jax.jit(fn).lower(
+            sds((1, 4, 128), jnp.bfloat16),
+            sds((1, 1, 1, 128, 256), dt),
+            sds((1, 1, 1, 256, 128), dt),
+            sds((1, 1, 128), jnp.bfloat16),
+            sds((1, 1, 128), jnp.bfloat16),
+            sds((), jnp.int32), sds((1,), jnp.int32)).compile()
+        pfn = functools.partial(paged_decode_attention, scale=1.0,
+                                kv_scale=None)
+        jax.jit(pfn).lower(
+            sds((1, 4, 128), jnp.bfloat16),
+            sds((1, 4, 64, 1, 128), dt),
+            sds((1, 4, 64, 1, 128), dt),
+            sds((1, 1, 128), jnp.bfloat16),
+            sds((1, 1, 128), jnp.bfloat16),
+            sds((), jnp.int32), sds((1,), jnp.int32),
+            sds((1, 2), jnp.int32)).compile()
+        return True
+    except Exception:         # Mosaic rejects the dtype on this TPU gen
+        return False

@@ -1,0 +1,101 @@
+"""Golden tests for contrib hub wave 3 (reference: contrib/models/ —
+SURVEY §2.7): openai-gpt (post-LN), LFM2 (hybrid short-conv), VaultGemma,
+Apertus (xIELU), Phi-3.5-MoE (sparsemixer)."""
+
+import numpy as np
+import pytest
+import torch
+
+from test_contrib_hub import _check
+
+
+def test_openai_gpt_matches_hf(tmp_path):
+    from transformers import OpenAIGPTConfig, OpenAIGPTLMHeadModel
+    torch.manual_seed(0)
+    cfg = OpenAIGPTConfig(n_embd=64, n_head=4, n_layer=3, n_positions=128,
+                          vocab_size=256, resid_pdrop=0.0, embd_pdrop=0.0,
+                          attn_pdrop=0.0, torch_dtype="float32")
+    app = _check(tmp_path, "openai-gpt", OpenAIGPTLMHeadModel(cfg))
+    assert app.spec.norm_position == "post_residual"
+    assert app.spec.skip_final_norm and app.spec.no_rope
+
+
+def test_lfm2_matches_hf(tmp_path):
+    from transformers import Lfm2Config, Lfm2ForCausalLM
+    torch.manual_seed(0)
+    cfg = Lfm2Config(hidden_size=64, num_attention_heads=4,
+                     num_key_value_heads=2, num_hidden_layers=4,
+                     intermediate_size=128, vocab_size=256,
+                     layer_types=["conv", "conv", "full_attention", "conv"],
+                     conv_L_cache=3, conv_bias=False,
+                     block_auto_adjust_ff_dim=False,
+                     max_position_embeddings=128, torch_dtype="float32")
+    app = _check(tmp_path, "lfm2", Lfm2ForCausalLM(cfg))
+    assert app.spec.ssm.kind == "shortconv"
+    assert app.spec.ssm_pattern == (True, True, False, True)
+    assert app.cache["k"].shape[0] == 1          # one attention layer
+    assert app.cache["conv_x"].shape == (3, 2, 64, 2)
+    assert "ssm" not in app.cache                # conv state only
+
+
+def test_lfm2_conv_bias_and_auto_ff(tmp_path):
+    from transformers import Lfm2Config, Lfm2ForCausalLM
+    torch.manual_seed(1)
+    cfg = Lfm2Config(hidden_size=64, num_attention_heads=4,
+                     num_key_value_heads=2, num_hidden_layers=2,
+                     intermediate_size=96, vocab_size=256,
+                     layer_types=["conv", "full_attention"],
+                     conv_L_cache=4, conv_bias=True,
+                     block_auto_adjust_ff_dim=True,
+                     block_multiple_of=16, block_ffn_dim_multiplier=1.0,
+                     max_position_embeddings=128, torch_dtype="float32")
+    app = _check(tmp_path, "lfm2", Lfm2ForCausalLM(cfg))
+    # 2*96/3 = 64 rounded up to multiple of 16
+    assert app.spec.intermediate_size == 64
+    assert app.spec.ssm.conv_bias
+
+
+def test_vaultgemma_matches_hf(tmp_path):
+    from transformers import VaultGemmaConfig, VaultGemmaForCausalLM
+    torch.manual_seed(0)
+    cfg = VaultGemmaConfig(
+        hidden_size=64, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_hidden_layers=4, intermediate_size=128,
+        vocab_size=256, sliding_window=16, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, query_pre_attn_scalar=16,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+        max_position_embeddings=128, torch_dtype="float32")
+    app = _check(tmp_path, "vaultgemma", VaultGemmaForCausalLM(cfg))
+    assert app.spec.layer_pattern == (True, False, True, False)
+    assert app.spec.attn_soft_cap == 50.0
+    assert app.spec.norm_offset == 1.0 and not app.spec.sandwich_norm
+
+
+def test_apertus_matches_hf(tmp_path):
+    from transformers import ApertusConfig, ApertusForCausalLM
+    torch.manual_seed(0)
+    cfg = ApertusConfig(hidden_size=64, num_attention_heads=4,
+                        num_key_value_heads=2, num_hidden_layers=3,
+                        intermediate_size=128, vocab_size=256,
+                        max_position_embeddings=128,
+                        torch_dtype="float32")
+    app = _check(tmp_path, "apertus", ApertusForCausalLM(cfg))
+    assert app.spec.act == "xielu" and not app.spec.mlp_glu
+    assert app.spec.qk_norm
+
+
+def test_phimoe_matches_hf(tmp_path):
+    from transformers.models.phimoe import PhimoeConfig, PhimoeForCausalLM
+    torch.manual_seed(0)
+    cfg = PhimoeConfig(hidden_size=64, num_attention_heads=4,
+                       num_key_value_heads=2, num_hidden_layers=2,
+                       intermediate_size=96, vocab_size=256,
+                       num_local_experts=4, num_experts_per_tok=2,
+                       router_jitter_noise=0.01, input_jitter_noise=0.0,
+                       attention_bias=True, lm_head_bias=True,
+                       max_position_embeddings=128,
+                       tie_word_embeddings=False, torch_dtype="float32")
+    app = _check(tmp_path, "phimoe", PhimoeForCausalLM(cfg))
+    assert app.spec.moe.router_act == "sparsemixer"
+    assert app.spec.norm_type == "layernorm" and app.spec.norm_bias
+    assert app.spec.lm_head_bias

@@ -405,3 +405,113 @@ def test_paged_decode_kernel_stacked_layers(rng):
                                 lens, table, scale)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5, err_msg=f"layer {li}")
+
+
+# ---------------------------------------------------------------------------
+# Quantized-KV admission (reference: fp8 KV cache feeding the TKG kernel,
+# kv_cache_manager.py:636-692): the kernel dequantizes on the block load.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kv_dtype,kv_scale", [
+    (jnp.float8_e4m3fn, None),        # direct-cast fp8
+    (jnp.float8_e4m3fn, 0.25),        # scaled fp8
+    (jnp.bfloat16, 2.0),              # scaled bf16
+])
+def test_decode_attention_quantized_kv(rng, kv_dtype, kv_scale):
+    from neuronx_distributed_inference_tpu.modules import kv_cache as kv
+    b, s, hq, hkv, d = 2, 256, 4, 2, 64
+    lens = np.array([100, 255], np.int32)
+    q = _rand(rng, b, hq, d)
+    kc_f = _rand(rng, b, hkv, d, s)
+    vc_f = _rand(rng, b, hkv, s, d)
+    nk = _rand(rng, b, hkv, d)
+    nv = _rand(rng, b, hkv, d)
+    # quantize the cache the way the write path does
+    kc_q = kv.quantize_kv(kc_f, kv_dtype, kv_scale)
+    vc_q = kv.quantize_kv(vc_f, kv_dtype, kv_scale)
+    scale = d ** -0.5
+    got = da.decode_attention(
+        q, kc_q, vc_q, nk, nv, jnp.asarray(lens, jnp.int32), scale=scale,
+        kv_scale=kv_scale, block_s=64, interpret=True)
+    # XLA-path reference over the DEQUANTIZED cache with a full-precision
+    # active token (the kernel folds the active token in-registers)
+    kc_d = kv.dequantize_kv(kc_q, jnp.float32, kv_scale)
+    vc_d = kv.dequantize_kv(vc_q, jnp.float32, kv_scale)
+    want = _reference(q, kc_d, vc_d, nk, nv, lens, scale)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_decode_attention_quantized_kv(rng):
+    from neuronx_distributed_inference_tpu.modules import kv_cache as kv
+    kv_scale = 0.5
+    b, hq, hkv, d = 2, 4, 2, 64
+    bs, nblocks, mb = 64, 8, 4
+    lens = np.array([70, 130], np.int32)
+    table = jnp.asarray(np.array([[1, 2, 0, 0], [3, 4, 5, 0]], np.int32))
+    q = _rand(rng, b, hq, d)
+    kp_f = _rand(rng, 1, nblocks, bs, hkv, d)
+    vp_f = _rand(rng, 1, nblocks, bs, hkv, d)
+    nk = _rand(rng, b, hkv, d)
+    nv = _rand(rng, b, hkv, d)
+    kp_q = kv.quantize_kv(kp_f, jnp.float8_e4m3fn, kv_scale)
+    vp_q = kv.quantize_kv(vp_f, jnp.float8_e4m3fn, kv_scale)
+    scale = d ** -0.5
+    got = da.paged_decode_attention(
+        q, kp_q, vp_q, nk, nv, jnp.zeros((), jnp.int32),
+        jnp.asarray(lens), table, scale=scale, kv_scale=kv_scale,
+        interpret=True)
+    # gather-path reference: dequantized pages -> contiguous rows
+    kp_d = np.asarray(kv.dequantize_kv(kp_q, jnp.float32, kv_scale))[0]
+    vp_d = np.asarray(kv.dequantize_kv(vp_q, jnp.float32, kv_scale))[0]
+    tbl = np.asarray(table)
+    k_rows = kp_d[tbl].reshape(b, mb * bs, hkv, d)
+    v_rows = vp_d[tbl].reshape(b, mb * bs, hkv, d)
+    rows = np.arange(b)
+    k_rows[rows, lens] = np.asarray(nk)
+    v_rows[rows, lens] = np.asarray(nv)
+    mask = attn_ops.decode_mask(jnp.asarray(lens)[:, None], mb * bs)
+    want = attn_ops.mha(q[:, None], jnp.asarray(k_rows),
+                        jnp.asarray(v_rows), mask, scale)[:, 0]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_kernel_e2e_fp8_kv(hd64_ckpt):
+    """fp8-KV serving must ADMIT the kernel (no more full-gather fallback)
+    and reproduce the XLA path's tokens/logits over the same fp8 cache."""
+    from neuronx_distributed_inference_tpu.config import (
+        TpuConfig, load_pretrained_config)
+    from neuronx_distributed_inference_tpu.models.application import \
+        CausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.parallel.mesh import (
+        MeshConfig, build_mesh)
+
+    def fp8_app(enabled):
+        tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                         output_logits=True, enable_bucketing=False,
+                         kv_cache_dtype="float8_e4m3fn",
+                         kv_cache_quant=True, kv_cache_scale=2.0,
+                         attn_block_tkg_kernel_enabled=enabled)
+        icfg = LlamaInferenceConfig(tcfg,
+                                    load_config=load_pretrained_config(
+                                        hd64_ckpt))
+        app = CausalLMApplication(hd64_ckpt, icfg, LlamaFamily,
+                                  mesh=build_mesh(MeshConfig(tp=1)))
+        app.load_weights().init_cache()
+        return app
+
+    prompts = np.random.default_rng(7).integers(
+        1, 500, size=(2, 12)).astype(np.int32)
+    app_k = fp8_app(True)
+    assert app_k.spec.kv_scale == 2.0
+    assert app_k.cache["k"].dtype == jnp.float8_e4m3fn
+    out_k = app_k.generate(prompts, max_new_tokens=8, return_logits=True)
+    out_x = fp8_app(False).generate(prompts, max_new_tokens=8,
+                                    return_logits=True)
+    # the kernel folds the ACTIVE token full-precision while the XLA path
+    # reads it back quantized — tolerance covers that one-token delta
+    for a, b in zip(out_k["logits"], out_x["logits"]):
+        np.testing.assert_allclose(a, b, atol=5e-2, rtol=5e-2)

@@ -147,3 +147,64 @@ def test_mixed_per_layer_kv_cache_halves_bytes(tmp_path):
     want = a_full.generate(ids, attention_mask=mask, max_new_tokens=12)
     got = a_mix.generate(ids, attention_mask=mask, max_new_tokens=12)
     np.testing.assert_array_equal(got["generated"], want["generated"])
+
+
+def test_mixed_kv_continuous_batching_serving(tmp_path):
+    """gpt-oss is a SERVING model: the mixed per-layer cache must work
+    under the continuous-batching adapter — interleaved requests on a
+    mixed cache reproduce each request's uniform-cache greedy tokens,
+    with the KV bytes still ~halved (reference:
+    modules/kvcache/gpt_oss_kv_cache_manager.py serving the vLLM path)."""
+    import dataclasses
+    import jax
+    from neuronx_distributed_inference_tpu.serving import \
+        ContinuousBatchingAdapter
+
+    d, _ = _save_tiny_gpt_oss(tmp_path)
+
+    def app_for(mixed):
+        app = _build_app(d, batch_size=4, seq_len=48,
+                         is_continuous_batching=True,
+                         enable_bucketing=True,
+                         context_encoding_buckets=[16])
+        if not mixed:
+            app.spec = dataclasses.replace(app.spec, mixed_kv=False)
+            app._compiled = {}
+            app.init_cache()
+        return app
+
+    rng = np.random.default_rng(3)
+    p1 = rng.integers(1, 250, size=9).tolist()
+    p2 = rng.integers(1, 250, size=12).tolist()
+
+    def run(app):
+        eng = ContinuousBatchingAdapter(app)
+        got = {}
+        first = eng.add_requests([2], [p1])
+        toks1 = [first[2]]
+        for _ in range(3):
+            toks1.append(eng.step()[2])
+        first2 = eng.add_requests([0], [p2])
+        toks2 = [first2[0]]
+        for _ in range(4):
+            s = eng.step()
+            toks1.append(s.get(2))
+            toks2.append(s.get(0))
+        eng.release([2])
+        for _ in range(3):
+            toks2.append(eng.step()[0])
+        got[1] = [t for t in toks1 if t is not None][:8]
+        got[2] = toks2[:8]
+        return got
+
+    a_mix = app_for(True)
+    assert a_mix.spec.mixed_kv and "k_l" in a_mix.cache
+    bytes_mix = sum(x.size * x.dtype.itemsize
+                    for x in jax.tree.leaves(a_mix.cache))
+    a_full = app_for(False)
+    bytes_full = sum(x.size * x.dtype.itemsize
+                     for x in jax.tree.leaves(a_full.cache))
+    assert bytes_mix < 0.62 * bytes_full, (bytes_mix, bytes_full)
+    got_mix = run(a_mix)
+    got_full = run(a_full)
+    assert got_mix == got_full

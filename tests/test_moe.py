@@ -146,3 +146,37 @@ def test_moe_family_tp_ep_mesh(tmp_path):
     out = app._run_prefill(ids.astype(np.int32), np.full((2,), 10, np.int32))
     np.testing.assert_allclose(np.asarray(out["logits"]), golden,
                                atol=5e-3, rtol=1e-3)
+
+
+def test_moe_hybrid_tkg_sharding_matches(tmp_path):
+    """Hybrid CTE/TKG expert sharding (reference: moe_v2.py:135-161
+    HybridShardingConfig with moe_tkg_ep_degree=1): decode re-constrains
+    the expert weights all-experts-local; generation must match the
+    uniform-sharding run token for token."""
+    from neuronx_distributed_inference_tpu.config import MoEConfig
+    d, hf = _save_tiny_moe(tmp_path, "mixtral")
+    family = get_family("mixtral")
+
+    def run(hybrid):
+        mc = MoEConfig(moe_tkg_ep_degree=1) if hybrid else None
+        kw = dict(batch_size=2, seq_len=48, dtype="float32",
+                  output_logits=True, enable_bucketing=False,
+                  tp_degree=4, ep_degree=2)
+        if mc is not None:
+            kw["moe_config"] = mc
+        tcfg = TpuConfig(**kw)
+        icfg = family.config_cls(tcfg, load_config=load_pretrained_config(d))
+        app = CausalLMApplication(d, icfg, family)
+        app.load_weights().init_cache()
+        if hybrid:
+            assert app.spec.moe.tkg_experts_local
+        ids = np.random.default_rng(1).integers(1, 256, size=(2, 10),
+                                                dtype=np.int64)
+        return app.generate(ids.astype(np.int32), max_new_tokens=8,
+                            return_logits=True)
+
+    base = run(False)
+    hyb = run(True)
+    np.testing.assert_array_equal(hyb["generated"], base["generated"])
+    for a, b in zip(hyb["logits"], base["logits"]):
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=1e-4)
