@@ -1,0 +1,157 @@
+"""Gemma3 multimodal — SigLIP tower + avg-pool projector + bidirectional
+image-span attention on the gemma3 text stack (reference:
+contrib/models/gemma3-vision; HF Gemma3ForConditionalGeneration).
+
+TPU mapping: the SigLIP encoder rides the shared ViT base
+(models/vision.py — patch-bias + post-layernorm flags), the projector is
+rms-norm → 2-D average pool → a single (C_vis, H_text) matmul, and the
+image-block bidirectional attention is an in-graph mask overlay on the
+prefill masks (model_base.context_encoding_step, spec.bidir_image_attn) —
+no reference to HF's vmapped mask closures."""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig
+from ..ops.normalization import rms_norm
+from ..utils import checkpoint as ckpt
+from . import vision
+from .application import CausalLMApplication
+from .family import register_family
+from .gemma3.modeling_gemma3 import Gemma3Family
+
+
+class Gemma3VLInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "mm_tokens_per_image"]
+
+    def get_text_config(self) -> InferenceConfig:
+        tc = dict(self.text_config)
+        tc.setdefault("model_type", "gemma3_text")
+        return Gemma3VLTextFamily.config_cls(self.tpu_config, **tc)
+
+
+@register_family("gemma3_vl_text")
+class Gemma3VLTextFamily(Gemma3Family):
+    """gemma3 text + the bidirectional image-span attention overlay."""
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        from dataclasses import replace
+        return replace(super().build_spec(config, tp_degree),
+                       bidir_image_attn=True)
+
+
+class Gemma3VLApplication:
+    """SigLIP tower + projector + gemma3 text LM."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: Gemma3VLInferenceConfig, mesh=None):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        Gemma3VLTextFamily, mesh=mesh)
+        vc = dict(config.vision_config)
+        self.vit_spec = vision.vit_spec_from_hf(vc, feature_layer=-1)
+        # SigLIP: no CLS, no pre-LN, biased patch conv, final post-LN
+        from dataclasses import replace
+        self.vit_spec = replace(
+            self.vit_spec, use_cls_token=False, pre_layernorm=False,
+            patch_bias=True, post_layernorm=True,
+            act=vc.get("hidden_act", "gelu_pytorch_tanh"))
+        self.image_token_id = int(
+            getattr(config, "image_token_index",
+                    getattr(config, "image_token_id", 262144)))
+        self.mm_tokens = int(config.mm_tokens_per_image)
+        self.vision_params = None
+        self.projector = None
+        self._vit = jax.jit(partial(vision.vit_forward, self.vit_spec))
+        self._project = jax.jit(self._project_fn)
+
+    def load_weights(self):
+        sd = ckpt.load_state_dict(self.model_path)
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+                continue
+            for pre, new in (("model.language_model.", "model."),
+                             ("language_model.model.", "model."),
+                             ("language_model.", "model.")):
+                if k.startswith(pre):
+                    text_sd[new + k[len(pre):]] = v
+                    break
+        host = self.text.family.convert_hf_state_dict(text_sd,
+                                                      self.text.spec)
+        self.text._put_params(host)
+
+        vis_prefix = ("model.vision_tower" if any(
+            k.startswith("model.vision_tower") for k in sd)
+            else "vision_tower")
+        self.vision_params = jax.tree.map(
+            jnp.asarray,
+            vision.convert_clip_vision_tower(sd, self.vit_spec, vis_prefix))
+        pp = ("model.multi_modal_projector" if any(
+            k.startswith("model.multi_modal_projector") for k in sd)
+            else "multi_modal_projector")
+        self.projector = {
+            "mm_w": jnp.asarray(np.asarray(
+                sd[f"{pp}.mm_input_projection_weight"], np.float32)),
+            "norm_w": jnp.asarray(np.asarray(
+                sd[f"{pp}.mm_soft_emb_norm.weight"], np.float32)),
+        }
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def _project_fn(self, projector, feats):
+        """(B, P, C) SigLIP features -> (B, mm_tokens, H_text): avg-pool the
+        patch grid to tokens_per_side^2, gemma (1+w) rms-norm, project
+        (reference: HF Gemma3MultiModalProjector.forward)."""
+        b, p, c = feats.shape
+        side = int(math.isqrt(p))
+        tside = int(math.isqrt(self.mm_tokens))
+        k = side // tside
+        x = feats.reshape(b, side, side, c)
+        x = x.reshape(b, tside, k, tside, k, c).mean(axis=(2, 4))
+        x = x.reshape(b, tside * tside, c)
+        x = rms_norm(x, projector["norm_w"],
+                     float(dict(self.config.vision_config).get(
+                         "layer_norm_eps", 1e-6)), offset=1.0)
+        return x @ projector["mm_w"]
+
+    def encode_images(self, pixel_values: np.ndarray) -> jnp.ndarray:
+        feats = self._vit(self.vision_params, jnp.asarray(pixel_values))
+        return self._project(self.projector, feats)
+
+    def generate(self, input_ids: np.ndarray, pixel_values: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32, **kw) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        image_mask = (input_ids == self.image_token_id)
+        feats = np.asarray(self.encode_images(pixel_values))
+        per_row = image_mask.sum(axis=1)
+        if not (per_row == per_row[0]).all():
+            raise ValueError("rows must hold equal image-token counts")
+        image_embeds = feats.reshape(b, per_row[0], -1)
+        if self.text.cache is None:
+            self.text.init_cache()
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens,
+            image_embeds=image_embeds, image_mask=image_mask, **kw)
+
+    def reset(self):
+        self.text.reset()
+        return self

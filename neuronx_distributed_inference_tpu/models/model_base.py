@@ -200,6 +200,11 @@ class DecoderSpec:
     # no final pre-lm-head norm (GPT-1: the post-LN blocks already end
     # normed; reference: contrib/models/openai-gpt)
     skip_final_norm: bool = False
+    # gemma3 multimodal: image-token spans attend BIDIRECTIONALLY within
+    # their own contiguous image block, overriding causality AND the
+    # sliding window (reference: contrib/models/gemma3-vision; HF
+    # token_type_ids_mask_function or-mask)
+    bidir_image_attn: bool = False
     # LayerNorm with learned bias (gpt2/falcon/starcoder2/phi/neox)
     norm_bias: bool = False
     # GLU MLP (act(gate)*up @ down, llama-shaped) vs plain 2-layer MLP
@@ -1044,6 +1049,7 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
         if (spec.flash_prefill and arange_positions
                 and spec.layer_pattern is None and not spec.attn_sink
                 and not spec.alibi
+                and not spec.bidir_image_attn
                 and spec.mla is None and not spec.cp_prefill
                 and not spec.seq_parallel
                 and flash_attention.supports(
@@ -1636,6 +1642,21 @@ def context_encoding_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         img = jnp.take_along_axis(image_embeds.astype(hidden.dtype),
                                   gather_idx[..., None], axis=1)
         hidden = jnp.where(image_mask[..., None], img, hidden)
+    if spec.bidir_image_attn and image_mask is not None:
+        # OR a bidirectional overlay over each contiguous image-token span
+        # onto BOTH mask variants (it overrides the sliding window too —
+        # reference: HF gemma3 token_type_ids_mask_function applied to the
+        # full and sliding mask kwargs alike)
+        is_img = image_mask.astype(bool)
+        new_start = jnp.logical_and(
+            is_img, ~jnp.pad(is_img, ((0, 0), (1, 0)))[:, :-1])
+        gid = jnp.cumsum(new_start.astype(jnp.int32), axis=1) - 1
+        gid = jnp.where(is_img, gid, -1)
+        overlay = jnp.logical_and(gid[:, :, None] >= 0,
+                                  gid[:, :, None] == gid[:, None, :])
+        for mk in ("mask", "mask_l"):
+            if mk in ai:
+                ai[mk] = jnp.logical_or(ai[mk], overlay)
     if spec.seq_parallel:
         # SP: shard the embedded sequence (reference: reduce-scatter of
         # embeddings, model_base.py:1482-1517)

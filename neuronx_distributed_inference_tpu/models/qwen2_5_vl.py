@@ -1,0 +1,238 @@
+"""Qwen2.5-VL — windowed vision attention on the qwen2-vl base
+(reference: contrib/models/Qwen2.5-VL-3B-Instruct/src/
+modeling_qwen2_5_vl.py and contrib/models/Qwen2.5-VL-32B-Instruct).
+
+Vision deltas vs qwen2-vl: RMSNorm blocks (no bias), SiLU-GLU MLP with
+biases, RMSNorm patch merger, and WINDOWED attention — every block except
+``fullatt_block_indexes`` attends only within a ``window_size``-pixel
+window of its image. The HF implementation reorders patches so windows are
+contiguous (flash-attn cu_seqlens); attention is permutation-invariant
+under the right mask, so here patches stay in the merge-group order and
+window layers just use a per-patch window-id equality mask — no reorder,
+no un-reorder, and the merger sees the same groups. The text decoder is
+qwen2 + M-RoPE, unchanged from qwen2-vl."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig
+from ..ops.normalization import rms_norm
+from .family import register_family
+from .qwen2_vl.modeling_qwen2_vl import (Qwen2VLApplication,
+                                         Qwen2VLInferenceConfig,
+                                         Qwen2VLTextFamily)
+
+
+@dataclass(frozen=True)
+class Qwen25VisionSpec:
+    depth: int
+    embed_dim: int
+    num_heads: int
+    intermediate_size: int
+    patch_input: int
+    patch_size: int
+    spatial_merge: int
+    out_hidden: int
+    window_size: int
+    fullatt_idx: Tuple[int, ...]
+    act: str = "silu"
+    eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def vision_spec_from_hf_25(vc: Dict[str, Any]) -> Qwen25VisionSpec:
+    return Qwen25VisionSpec(
+        depth=int(vc["depth"]),
+        embed_dim=int(vc["hidden_size"]),
+        num_heads=int(vc["num_heads"]),
+        intermediate_size=int(vc["intermediate_size"]),
+        patch_input=(int(vc.get("in_channels", 3))
+                     * int(vc.get("temporal_patch_size", 2))
+                     * int(vc["patch_size"]) ** 2),
+        patch_size=int(vc["patch_size"]),
+        spatial_merge=int(vc.get("spatial_merge_size", 2)),
+        out_hidden=int(vc["out_hidden_size"]),
+        window_size=int(vc.get("window_size", 0)),
+        fullatt_idx=tuple(int(i) for i in
+                          vc.get("fullatt_block_indexes", ())),
+        act=str(vc.get("hidden_act", "silu")),
+    )
+
+
+def vision_forward_25(spec: Qwen25VisionSpec, params: Dict[str, Any],
+                      patches: jnp.ndarray, cos: jnp.ndarray,
+                      sin: jnp.ndarray, image_ids: jnp.ndarray,
+                      window_ids: jnp.ndarray) -> jnp.ndarray:
+    """patches (N, patch_input) in merge-group order; window_ids (N,)
+    per-patch window id (globally unique across images). Returns merged
+    features (N/merge^2, out_hidden)."""
+    n = patches.shape[0]
+    nh, hd = spec.num_heads, spec.head_dim
+    act = jax.nn.silu
+    x = patches @ params["patch_proj"]
+    img_mask = (image_ids[:, None] == image_ids[None, :])
+    win_mask = jnp.logical_and(
+        img_mask, window_ids[:, None] == window_ids[None, :])
+
+    def rope2d(t):
+        tf = t.astype(jnp.float32)
+        d2 = cos.shape[-1]
+        t1, t2 = tf[..., :d2], tf[..., d2:]
+        c, s = cos[:, None, :], sin[:, None, :]
+        return jnp.concatenate([t1 * c - t2 * s, t2 * c + t1 * s],
+                               axis=-1).astype(t.dtype)
+
+    for i in range(spec.depth):
+        lw = jax.tree.map(lambda a: a[i], params["layers"])
+        mask = img_mask if (i in spec.fullatt_idx
+                            or spec.window_size == 0) else win_mask
+        r = rms_norm(x, lw["ln1_w"], spec.eps)
+        qkv = r @ lw["qkv_w"] + lw["qkv_b"]
+        q, k, v = jnp.split(qkv.reshape(n, 3, nh, hd), 3, axis=1)
+        q = rope2d(q[:, 0])
+        k = rope2d(k[:, 0])
+        v = v[:, 0]
+        s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        s = jnp.where(mask[None], s, -1e30)
+        pr = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("hqk,khd->qhd", pr, v.astype(jnp.float32))
+        x = x + (a.reshape(n, -1).astype(x.dtype) @ lw["proj_w"]
+                 + lw["proj_b"])
+        r = rms_norm(x, lw["ln2_w"], spec.eps)
+        m = act(r @ lw["gate_w"] + lw["gate_b"]) * (r @ lw["up_w"]
+                                                    + lw["up_b"])
+        x = x + m @ lw["down_w"] + lw["down_b"]
+
+    x = rms_norm(x, params["ln_q_w"], spec.eps)
+    x = x.reshape(n // spec.spatial_merge ** 2, -1)
+    x = jax.nn.gelu(x @ params["mlp0_w"] + params["mlp0_b"],
+                    approximate=False)
+    return x @ params["mlp2_w"] + params["mlp2_b"]
+
+
+def convert_vision_tower_25(sd: Dict[str, np.ndarray],
+                            spec: Qwen25VisionSpec,
+                            prefix: str = "visual") -> Dict[str, Any]:
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"blocks.{i}"
+        return {
+            "ln1_w": get(f"{b}.norm1.weight"),
+            "ln2_w": get(f"{b}.norm2.weight"),
+            "qkv_w": t(get(f"{b}.attn.qkv.weight")),
+            "qkv_b": get(f"{b}.attn.qkv.bias"),
+            "proj_w": t(get(f"{b}.attn.proj.weight")),
+            "proj_b": get(f"{b}.attn.proj.bias"),
+            "gate_w": t(get(f"{b}.mlp.gate_proj.weight")),
+            "gate_b": get(f"{b}.mlp.gate_proj.bias"),
+            "up_w": t(get(f"{b}.mlp.up_proj.weight")),
+            "up_b": get(f"{b}.mlp.up_proj.bias"),
+            "down_w": t(get(f"{b}.mlp.down_proj.weight")),
+            "down_b": get(f"{b}.mlp.down_proj.bias"),
+        }
+
+    layers = [lw(i) for i in range(spec.depth)]
+    return {
+        "patch_proj": t(get("patch_embed.proj.weight").reshape(
+            spec.embed_dim, -1)),
+        "layers": {k: np.stack([d[k] for d in layers]) for k in layers[0]},
+        "ln_q_w": get("merger.ln_q.weight"),
+        "mlp0_w": t(get("merger.mlp.0.weight")),
+        "mlp0_b": get("merger.mlp.0.bias"),
+        "mlp2_w": t(get("merger.mlp.2.weight")),
+        "mlp2_b": get("merger.mlp.2.bias"),
+    }
+
+
+def vision_window_ids(grid_thw: np.ndarray, spec: Qwen25VisionSpec
+                      ) -> np.ndarray:
+    """Per-patch window id in the merge-group-permuted order (the order
+    vision_rot_angles emits). Window extent = window_size pixels =
+    window_size / patch_size / merge positions of the MERGED grid
+    (reference: get_window_index vit_merger_window_size)."""
+    m = spec.spatial_merge
+    vw = max(spec.window_size // m // spec.patch_size, 1)
+    out = []
+    base = 0
+    for t, h, w in np.asarray(grid_thw):
+        hp = np.arange(h)[:, None] * np.ones((1, w), np.int64)
+        wp = np.ones((h, 1), np.int64) * np.arange(w)[None, :]
+
+        def perm(x):
+            return x.reshape(h // m, m, w // m, m).transpose(
+                0, 2, 1, 3).ravel()
+
+        lh = perm(hp) // m          # merged-grid coords per patch
+        lw_ = perm(wp) // m
+        nww = -(-(w // m) // vw)
+        wid = (lh // vw) * nww + (lw_ // vw)
+        n_win = nww * (-(-(h // m) // vw))
+        for ti in range(int(t)):
+            out.append(wid + base)
+            base += n_win
+    return np.concatenate(out, axis=0).astype(np.int32)
+
+
+class Qwen25VLInferenceConfig(Qwen2VLInferenceConfig):
+    pass
+
+
+@register_family("qwen2_5_vl_text")
+class Qwen25VLTextFamily(Qwen2VLTextFamily):
+    pass
+
+
+class Qwen25VLApplication(Qwen2VLApplication):
+    """Qwen2.5-VL: windowed vision tower + the qwen2-vl text stack."""
+
+    family = Qwen25VLTextFamily
+
+    def __init__(self, model_path: Optional[str],
+                 config: Qwen25VLInferenceConfig, mesh=None):
+        super().__init__(model_path, config, mesh=mesh)
+        self.vision_spec = vision_spec_from_hf_25(dict(config.vision_config))
+        self.spatial_merge = self.vision_spec.spatial_merge
+        self._vis_fn = jax.jit(
+            lambda p, patches, cos, sin, ids, wids: vision_forward_25(
+                self.vision_spec, p, patches, cos, sin, ids, wids))
+
+    def load_weights(self):
+        from ..utils import checkpoint as ckpt
+        sd = ckpt.load_state_dict(self.model_path)
+        remap = {}
+        for k, v in sd.items():
+            k2 = k.replace("model.language_model.", "model.")
+            k2 = k2.replace("model.visual.", "visual.")
+            remap[k2] = v
+        host = self.family.convert_hf_state_dict(remap, self.text.spec)
+        self.text._put_params(host)
+        self.vision_params = jax.tree.map(
+            jnp.asarray, convert_vision_tower_25(remap, self.vision_spec))
+        return self
+
+    def encode_images(self, pixel_patches: np.ndarray,
+                      grid_thw: np.ndarray) -> jnp.ndarray:
+        from .qwen2_vl.modeling_qwen2_vl import vision_rot_angles
+        ang = vision_rot_angles(grid_thw, self.vision_spec)
+        ids = np.repeat(np.arange(len(grid_thw)),
+                        [int(t * h * w) for t, h, w in np.asarray(grid_thw)])
+        wids = vision_window_ids(grid_thw, self.vision_spec)
+        return self._vis_fn(self.vision_params, jnp.asarray(pixel_patches),
+                            jnp.asarray(np.cos(ang)),
+                            jnp.asarray(np.sin(ang)),
+                            jnp.asarray(ids), jnp.asarray(wids))

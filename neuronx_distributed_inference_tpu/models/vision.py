@@ -37,6 +37,9 @@ class VitSpec:
     num_channels: int = 3
     use_cls_token: bool = True
     pre_layernorm: bool = True
+    # SigLIP (gemma3 tower): biased patch conv + final post_layernorm
+    patch_bias: bool = False
+    post_layernorm: bool = False
     act: str = "quick_gelu"
     eps: float = 1e-5
     # which hidden state feeds downstream (HF hidden_states indexing:
@@ -79,6 +82,8 @@ def vit_forward(spec: VitSpec, params, pixel_values) -> jnp.ndarray:
     x = jax.lax.conv_general_dilated(
         pixel_values, params["patch_embed"], (p, p), "VALID",
         dimension_numbers=dn)                       # (B, H, gh, gw)
+    if spec.patch_bias:
+        x = x + params["patch_embed_b"][None, :, None, None]
     b, h, gh, gw = x.shape
     x = x.reshape(b, h, gh * gw).transpose(0, 2, 1)  # (B, T, H)
     if spec.use_cls_token:
@@ -113,9 +118,12 @@ def vit_forward(spec: VitSpec, params, pixel_values) -> jnp.ndarray:
     x, states = jax.lax.scan(body, x, params["layers"])
     # hidden_states list = [embeddings] + per-layer outputs
     fl = spec.feature_layer % (spec.num_layers + 1)
-    if fl == 0:
-        return x * 0 + x  # embeddings themselves never used in practice
-    return states[fl - 1]
+    feats = states[fl - 1] if fl else x * 0 + x
+    if spec.post_layernorm and fl == spec.num_layers:
+        # SigLIP last_hidden_state semantics: final LN applied
+        feats = layer_norm(feats, params["ln_post_w"], params["ln_post_b"],
+                           spec.eps)
+    return feats
 
 
 def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
@@ -166,4 +174,9 @@ def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
         # HF CLIP ships this historical typo in the weight name
         out["ln_pre_w"] = get(f"{vm}.pre_layrnorm.weight")
         out["ln_pre_b"] = get(f"{vm}.pre_layrnorm.bias")
+    if spec.patch_bias:
+        out["patch_embed_b"] = get(f"{vm}.embeddings.patch_embedding.bias")
+    if spec.post_layernorm:
+        out["ln_post_w"] = get(f"{vm}.post_layernorm.weight")
+        out["ln_post_b"] = get(f"{vm}.post_layernorm.bias")
     return out

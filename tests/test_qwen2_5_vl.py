@@ -1,0 +1,105 @@
+"""Qwen2.5-VL golden test: WINDOWED vision attention + RMS/GLU vision
+blocks vs HF (reference: contrib/models/Qwen2.5-VL-3B-Instruct/src/
+modeling_qwen2_5_vl.py). The grid/window sizes are chosen so the merged
+grid splits into 4 real windows — the mask-based window path (no patch
+reorder) must match HF's reorder-based implementation exactly."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.qwen2_5_vl import (
+    Qwen25VLApplication, Qwen25VLInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import (Qwen2_5_VLConfig,
+                              Qwen2_5_VLForConditionalGeneration)
+    torch.manual_seed(0)
+    cfg = Qwen2_5_VLConfig(
+        text_config=dict(
+            hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, vocab_size=300,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            rope_theta=10000.0, max_position_embeddings=256,
+            rms_norm_eps=1e-5, tie_word_embeddings=False,
+            torch_dtype="float32"),
+        vision_config=dict(
+            depth=3, hidden_size=32, num_heads=2, in_channels=3,
+            out_hidden_size=64, intermediate_size=48, patch_size=4,
+            spatial_merge_size=2, temporal_patch_size=2,
+            window_size=16, fullatt_block_indexes=[1],
+            torch_dtype="float32"),
+        image_token_id=7, vision_start_token_id=5, vision_end_token_id=6)
+    m = Qwen2_5_VLForConditionalGeneration(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("qwen25vl")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def _build_inputs(cfg, b=2, grid=(1, 8, 8), n_text=6):
+    rng = np.random.default_rng(0)
+    t, h, w = grid
+    merge = cfg.vision_config.spatial_merge_size
+    n_img_tok = t * (h // merge) * (w // merge)
+    row = ([5] + [7] * n_img_tok + [6]
+           + rng.integers(10, 290, n_text).tolist())
+    ids = np.stack([np.asarray(row)] * b)
+    ids[1, -n_text:] = rng.integers(10, 290, n_text)
+    patch_dim = (cfg.vision_config.in_channels
+                 * cfg.vision_config.temporal_patch_size
+                 * cfg.vision_config.patch_size ** 2)
+    patches = rng.normal(size=(b * t * h * w, patch_dim)).astype(np.float32)
+    grid_thw = np.asarray([[t, h, w]] * b)
+    return ids.astype(np.int64), patches, grid_thw
+
+
+def test_qwen2_5_vl_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    ids, patches, grid_thw = _build_inputs(cfg)
+    # merged grid 4x4, window 16px -> 2x2 merged positions per window ->
+    # 4 windows; block 1 is full-attention, blocks 0/2 windowed
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Qwen25VLInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        image_token_id=cfg.image_token_id, model_type="qwen2_5_vl")
+    app = Qwen25VLApplication(d, icfg).load_weights().init_cache()
+    assert app.vision_spec.window_size == 16
+    assert app.vision_spec.fullatt_idx == (1,)
+
+    with torch.no_grad():
+        hf_feats = m.model.visual(torch.tensor(patches),
+                                  grid_thw=torch.tensor(grid_thw)).numpy()
+    got_feats = np.asarray(app.encode_images(patches, grid_thw))
+    np.testing.assert_allclose(got_feats, hf_feats, atol=2e-4, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_seq = m.generate(
+            input_ids=torch.tensor(ids),
+            pixel_values=torch.tensor(patches),
+            image_grid_thw=torch.tensor(grid_thw),
+            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixel_patches=patches,
+                       image_grid_thw=grid_thw, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+
+
+def test_window_ids_cover_merged_groups():
+    """Every merge^2 patch group shares one window id (the merger contract)
+    and the 4x4 merged grid with a 2-position window yields 4 windows."""
+    from neuronx_distributed_inference_tpu.models.qwen2_5_vl import (
+        Qwen25VisionSpec, vision_window_ids)
+    spec = Qwen25VisionSpec(
+        depth=1, embed_dim=32, num_heads=2, intermediate_size=48,
+        patch_input=96, patch_size=4, spatial_merge=2, out_hidden=64,
+        window_size=16, fullatt_idx=())
+    wids = vision_window_ids(np.asarray([[1, 8, 8]]), spec)
+    assert wids.shape == (64,)
+    assert len(np.unique(wids)) == 4
+    groups = wids.reshape(-1, 4)       # merge-group order: 4 patches/group
+    assert (groups == groups[:, :1]).all()
