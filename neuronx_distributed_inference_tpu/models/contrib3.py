@@ -16,6 +16,9 @@ from ..parallel.layers import place_q_weight, replicate_kv_weight
 from .contrib import GPT2Family, _SimpleConfig, _ident, _t
 from .family import DecoderFamily, register_family
 from .model_base import spec_from_config
+from .contrib import StableLmFamily
+from .olmo2.modeling_olmo2 import Olmo2Family
+from ..ops.rope import RopeConfig
 
 
 @register_family("openai-gpt")
@@ -354,3 +357,97 @@ class PhimoeFamily(DecoderFamily):
     def load_hf_model(cls, model_path: str):
         from transformers.models.phimoe import PhimoeForCausalLM
         return PhimoeForCausalLM.from_pretrained(model_path)
+
+
+@register_family("minicpm", "minicpm4")
+class MiniCPMFamily(DecoderFamily):
+    """MiniCPM / MiniCPM4 (reference: contrib/models/MiniCPM4-8B/src/
+    modeling_minicpm.py): llama shape with MuP-style scalings — embeddings
+    x scale_emb, every sublayer residual x scale_depth/sqrt(L), lm-head
+    input / (hidden/dim_model_base) — and longrope scaling for the 4-series.
+    The scalings map 1:1 onto existing spec knobs (embed_scale,
+    residual_multiplier, logits_divide)."""
+
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        L = config.num_hidden_layers
+        H = config.hidden_size
+        dmb = float(getattr(config, "dim_model_base", H) or H)
+        return spec_from_config(
+            config, tp_degree,
+            embed_scale=float(getattr(config, "scale_emb", 1.0)),
+            residual_multiplier=float(
+                getattr(config, "scale_depth", 1.0)) / math.sqrt(L),
+            logits_divide=H / dmb,
+        )
+
+
+@register_family("orion")
+class OrionFamily(StableLmFamily):
+    """Orion-14B (reference: contrib/models/orion-14b-chat/src/
+    modeling_orion.py): llama shape with biased LayerNorm everywhere —
+    structurally stablelm at full rotary without qkv biases, so the
+    LayerNorm-bias conversion is inherited."""
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        return spec_from_config(
+            config, tp_degree,
+            rms_eps=float(getattr(config, "rms_norm_eps", 1e-5)),
+            norm_type="layernorm", norm_bias=True,
+        )
+
+
+@register_family("internlm3")
+class InternLM3Family(DecoderFamily):
+    """InternLM3 (reference: contrib/models/internlm3-8b-instruct/src/
+    modeling_internlm3.py): llama shape with independent qkv_bias /
+    (o+mlp) bias knobs."""
+
+    config_cls = _SimpleConfig
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        return spec_from_config(
+            config, tp_degree,
+            qkv_bias=bool(getattr(config, "qkv_bias", False)),
+            o_bias=bool(getattr(config, "bias", False)),
+            mlp_bias=bool(getattr(config, "bias", False)),
+        )
+
+
+@register_family("olmo3")
+class Olmo3Family(Olmo2Family):
+    """OLMo-3 (reference: contrib/models/OLMo-3-7B-Think/src/
+    modeling_olmo3.py): olmo2's post-norm blocks + full-width q/k RMSNorm,
+    plus an alternating sliding/full layer pattern."""
+
+    @classmethod
+    def build_spec(cls, config, tp_degree=None):
+        lt = list(getattr(config, "layer_types", []) or [])
+        pattern = (tuple(t == "sliding_attention" for t in lt)
+                   if lt and not all(t == lt[0] for t in lt) else None)
+        all_sliding = bool(lt) and all(t == "sliding_attention" for t in lt)
+        window = int(getattr(config, "sliding_window", 0) or 0)
+        # HF olmo3 rotates sliding layers with PLAIN rope regardless of the
+        # config's rope_scaling (two rotary embeddings, rope_type="default"
+        # for sliding_attention)
+        local = None
+        if pattern is not None and getattr(config, "rope_scaling", None):
+            H = config.hidden_size
+            hd = (getattr(config, "head_dim", None)
+                  or H // config.num_attention_heads)
+            local = RopeConfig(head_dim=hd, rope_theta=float(
+                getattr(config, "rope_theta", 500000.0)))
+        return spec_from_config(
+            config, tp_degree,
+            norm_position="post",
+            sandwich_norm=True,
+            qk_norm_full=True,
+            sliding_window=window if (pattern is not None
+                                      or all_sliding) else 0,
+            layer_pattern=pattern,
+            local_rope=local,
+        )

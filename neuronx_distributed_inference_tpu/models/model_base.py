@@ -2029,6 +2029,8 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
     # rope; mrope = 3-axis multimodal sections (qwen2-VL)
     mrope_section = None
     mrope_interleaved = False
+    if rope_type == "su":          # legacy phi-3 name for longrope
+        rope_type = "longrope"
     if rope_type in ("default", "mrope"):
         if "mrope_section" in rope_scaling:
             mrope_section = tuple(int(x) for x in rope_scaling["mrope_section"])
@@ -2046,6 +2048,8 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
         original_max_position=int(
             rope_scaling.get("original_max_position_embeddings")
+            # phi-3 longrope keeps this at the config top level
+            or getattr(config, "original_max_position_embeddings", None)
             or getattr(config, "max_position_embeddings", 8192)),
         beta_fast=float(rope_scaling.get("beta_fast") or 32.0),
         beta_slow=float(rope_scaling.get("beta_slow") or 1.0),
@@ -2056,6 +2060,12 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         truncate=bool(rope_scaling.get("truncate", True)),
         mrope_section=mrope_section,
         mrope_interleaved=mrope_interleaved,
+        # longrope (phi-3 / minicpm4): per-slot rescale factor lists
+        short_factor=(tuple(float(x) for x in rope_scaling["short_factor"])
+                      if "short_factor" in rope_scaling else None),
+        long_factor=(tuple(float(x) for x in rope_scaling["long_factor"])
+                     if "long_factor" in rope_scaling else None),
+        max_position=int(getattr(config, "max_position_embeddings", 0) or 0),
     )
     vocab = config.vocab_size
     kw = dict(

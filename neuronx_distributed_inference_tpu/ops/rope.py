@@ -52,6 +52,12 @@ class RopeConfig:
     # apply_interleaved_mrope): slots cycle T,H,W,T,H,W,... up to 3*sec_h /
     # 3*sec_w for H/W, preserving frequency continuity; the tail stays T
     mrope_interleaved: bool = False
+    # longrope (phi-3 / minicpm4 — HF _compute_longrope_parameters): one
+    # rescale factor per frequency slot; the long list applies when the
+    # deployed max_position exceeds the original pretraining length
+    short_factor: Optional[Tuple[float, ...]] = None
+    long_factor: Optional[Tuple[float, ...]] = None
+    max_position: int = 0            # deployed max_position_embeddings
 
     @property
     def dim(self) -> int:
@@ -63,7 +69,8 @@ def _base_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
     return 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
 
 
-SUPPORTED_SCALING = (None, "default", "linear", "llama3", "yarn")
+SUPPORTED_SCALING = (None, "default", "linear", "llama3", "yarn",
+                     "longrope")
 
 
 def yarn_attention_factor(cfg: RopeConfig) -> float:
@@ -110,6 +117,12 @@ def compute_inv_freq(cfg: RopeConfig) -> jnp.ndarray:
             f"(supported: {SUPPORTED_SCALING})")
     if cfg.scaling_type == "yarn":
         return _yarn_inv_freq(cfg)
+    if cfg.scaling_type == "longrope":
+        use_long = (cfg.max_position > cfg.original_max_position
+                    and cfg.long_factor is not None)
+        ext = jnp.asarray(cfg.long_factor if use_long else cfg.short_factor,
+                          jnp.float32)
+        return _base_inv_freq(cfg) / ext
     inv_freq = _base_inv_freq(cfg)
     if cfg.scaling_type == "linear":
         inv_freq = inv_freq / cfg.scaling_factor
@@ -160,6 +173,14 @@ def rope_cos_sin(position_ids: jnp.ndarray, cfg: RopeConfig
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     if cfg.scaling_type == "yarn":
         f = yarn_attention_factor(cfg)
+        cos, sin = cos * f, sin * f
+    elif cfg.scaling_type == "longrope":
+        if cfg.attention_factor is not None:
+            f = float(cfg.attention_factor)
+        else:
+            factor = max(cfg.max_position / cfg.original_max_position, 1.0)
+            f = (1.0 if factor <= 1.0 else math.sqrt(
+                1.0 + math.log(factor) / math.log(cfg.original_max_position)))
         cos, sin = cos * f, sin * f
     return cos, sin
 
