@@ -1,0 +1,147 @@
+"""Janus (DeepSeek multimodal) — image UNDERSTANDING path: SigLIP-style
+vision encoder + MLP aligner + llama text stack (reference:
+contrib/models/Janus-1.3B). The VQ image-GENERATION head (vqmodel +
+generation_* weights) is out of scope — understanding is what the serving
+surface needs; the app raises loudly if asked to generate pixels."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig
+from ..utils import checkpoint as ckpt
+from . import vision
+from .application import CausalLMApplication
+from .family import get_family
+
+
+class JanusInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "vision_config", "image_token_id"]
+
+    def get_text_config(self) -> InferenceConfig:
+        tc = dict(self.text_config)
+        family = get_family(tc.get("model_type", "llama"))
+        return family.config_cls(self.tpu_config, **tc)
+
+
+class JanusApplication:
+    """Vision encoder + aligner + llama LM (understanding path)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: JanusInferenceConfig, mesh=None):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        mesh=mesh)
+        vc = dict(config.vision_config)
+        self.vit_spec = vision.VitSpec(
+            hidden_size=int(vc["hidden_size"]),
+            num_layers=int(vc["num_hidden_layers"]),
+            num_heads=int(vc["num_attention_heads"]),
+            intermediate_size=int(vc.get(
+                "intermediate_size",
+                vc["hidden_size"] * float(vc.get("mlp_ratio", 4.0)))),
+            patch_size=int(vc["patch_size"]),
+            image_size=int(vc["image_size"]),
+            num_channels=int(vc.get("num_channels", 3)),
+            use_cls_token=False, pre_layernorm=False, patch_bias=True,
+            post_layernorm=True,
+            act=vc.get("hidden_act", "gelu"),
+            eps=float(vc.get("layer_norm_eps", 1e-6)),
+            feature_layer=-1)
+        self.image_token_id = int(config.image_token_id)
+        self.vision_params = None
+        self.aligner = None
+        self._vit = jax.jit(partial(vision.vit_forward, self.vit_spec))
+        self._align = jax.jit(self._align_fn)
+
+    def load_weights(self):
+        sd = ckpt.load_state_dict(self.model_path)
+        text_sd = {}
+        for k, v in sd.items():
+            if k.endswith("lm_head.weight"):
+                text_sd["lm_head.weight"] = v
+            elif k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+        self.text.params = None
+        host = self.text.family.convert_hf_state_dict(text_sd, self.text.spec)
+        self.text._put_params(host)
+        self.vision_params = jax.tree.map(
+            jnp.asarray, vision.convert_clip_vision_tower(
+                sd, self.vit_spec, "model.vision_model",
+                o_proj_name="projection_layer", bare_prefix=True))
+
+        def t(w):
+            return jnp.asarray(np.ascontiguousarray(
+                np.asarray(w, np.float32).T))
+
+        hidden = []
+        i = 0
+        while f"model.aligner.hidden_layers.{i}.weight" in sd:
+            hidden.append(
+                (t(sd[f"model.aligner.hidden_layers.{i}.weight"]),
+                 jnp.asarray(np.asarray(
+                     sd[f"model.aligner.hidden_layers.{i}.bias"],
+                     np.float32))))
+            i += 1
+        self.aligner = {
+            "fc1_w": t(sd["model.aligner.fc1.weight"]),
+            "fc1_b": jnp.asarray(np.asarray(sd["model.aligner.fc1.bias"],
+                                            np.float32)),
+            "hidden": hidden,
+        }
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def _align_fn(self, aligner, feats):
+        """HF JanusVisionAlignerMLP: fc1 then GELU->linear per hidden layer."""
+        h = feats @ aligner["fc1_w"] + aligner["fc1_b"]
+        for w, b in aligner["hidden"]:
+            h = jax.nn.gelu(h, approximate=False) @ w + b
+        return h
+
+    def encode_images(self, pixel_values: np.ndarray) -> jnp.ndarray:
+        feats = self._vit(self.vision_params, jnp.asarray(pixel_values))
+        return self._align(self.aligner, feats)
+
+    def generate(self, input_ids: np.ndarray, pixel_values: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32, **kw) -> Dict[str, Any]:
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        image_mask = (input_ids == self.image_token_id)
+        feats = np.asarray(self.encode_images(pixel_values))
+        per_row = image_mask.sum(axis=1)
+        if not (per_row == per_row[0]).all():
+            raise ValueError("rows must hold equal image-token counts")
+        n_patch = feats.shape[0] * feats.shape[1] // b
+        if per_row[0] != n_patch:
+            raise ValueError(
+                f"prompt holds {per_row[0]} image tokens per row but the "
+                f"encoder emitted {n_patch} patch features per row")
+        image_embeds = feats.reshape(b, per_row[0], -1)
+        if self.text.cache is None:
+            self.text.init_cache()
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens,
+            image_embeds=image_embeds, image_mask=image_mask, **kw)
+
+    def generate_images(self, *a, **k):
+        raise NotImplementedError(
+            "Janus VQ image generation (vqmodel + generation_head) is not "
+            "implemented; only the understanding path is supported")
+
+    def reset(self):
+        self.text.reset()
+        return self

@@ -17,12 +17,20 @@ class MistralInferenceConfig(InferenceConfig):
                 "num_key_value_heads", "vocab_size", "intermediate_size"]
 
 
-@register_family("mistral")
+@register_family("mistral", "ministral")
 class MistralFamily(DecoderFamily):
+    """mistral + ministral (reference: contrib/models/
+    Ministral-4b-instruct — mistral-shaped, uniformly sliding layers)."""
     config_cls = MistralInferenceConfig
 
     @classmethod
     def build_spec(cls, config: InferenceConfig, tp_degree: Optional[int] = None
                    ) -> DecoderSpec:
         window = getattr(config, "sliding_window", None) or 0
-        return spec_from_config(config, tp_degree, sliding_window=int(window))
+        lt = list(getattr(config, "layer_types", []) or [])
+        pattern = (tuple(t == "sliding_attention" for t in lt)
+                   if lt and not all(t == lt[0] for t in lt) else None)
+        if lt and all(t == "full_attention" for t in lt):
+            window = 0
+        return spec_from_config(config, tp_degree, sliding_window=int(window),
+                                layer_pattern=pattern)

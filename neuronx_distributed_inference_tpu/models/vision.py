@@ -127,9 +127,13 @@ def vit_forward(spec: VitSpec, params, pixel_values) -> jnp.ndarray:
 
 
 def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
-                              prefix: str) -> Dict[str, Any]:
+                              prefix: str, o_proj_name: str = "out_proj",
+                              bare_prefix: bool = False) -> Dict[str, Any]:
     """HF CLIPVisionModel names (``<prefix>.vision_model...``) -> param tree.
-    Sub-models with no CLS / no pre-LN skip those keys."""
+    Sub-models with no CLS / no pre-LN skip those keys. ``o_proj_name``:
+    the attention output projection module name (janus uses
+    "projection_layer"); ``bare_prefix``: the prefix already IS the vision
+    model root (no ".vision_model" segment)."""
 
     def get(n):
         if n in sd:
@@ -139,7 +143,7 @@ def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
     def t(w):
         return np.ascontiguousarray(np.asarray(w, np.float32).T)
 
-    vm = prefix + ".vision_model"
+    vm = prefix if bare_prefix else prefix + ".vision_model"
 
     def lw(i):
         b = f"{vm}.encoder.layers.{i}"
@@ -152,8 +156,8 @@ def convert_clip_vision_tower(sd: Dict[str, np.ndarray], spec: VitSpec,
             "k_b": get(f"{b}.self_attn.k_proj.bias"),
             "v_w": t(get(f"{b}.self_attn.v_proj.weight")),
             "v_b": get(f"{b}.self_attn.v_proj.bias"),
-            "o_w": t(get(f"{b}.self_attn.out_proj.weight")),
-            "o_b": get(f"{b}.self_attn.out_proj.bias"),
+            "o_w": t(get(f"{b}.self_attn.{o_proj_name}.weight")),
+            "o_b": get(f"{b}.self_attn.{o_proj_name}.bias"),
             "ln2_w": get(f"{b}.layer_norm2.weight"),
             "ln2_b": get(f"{b}.layer_norm2.bias"),
             "fc1_w": t(get(f"{b}.mlp.fc1.weight")),

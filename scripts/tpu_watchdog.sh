@@ -1,8 +1,27 @@
 #!/bin/bash
-# Poll the axon TPU tunnel; the moment it answers, run bench.py and persist
-# the result to BENCH_interim.json (front-loading perf evidence per the
-# round-4 outage lesson). Loops forever; caller kills it.
+# Poll the axon TPU tunnel; the moment it answers, run the FULL measurement
+# battery and persist every result under artifacts/ (front-loading perf
+# evidence per the round-4 outage lesson). Each battery member is retried
+# on later passes until it has produced output; the loop only exits once
+# EVERY member has succeeded. Caller kills it to stop early.
 cd "$(dirname "$0")/.." || exit 1
+mkdir -p artifacts
+log() { echo "$(date -Is) $*" >> /tmp/tpu_watchdog.log; }
+
+run_member() {  # run_member <name> <outfile> <timeout> <cmd...>
+  local name=$1 out=$2 to=$3; shift 3
+  if [ -s "$out" ]; then return 0; fi
+  if timeout "$to" "$@" > "$out.tmp" 2>>/tmp/tpu_battery_err.log \
+      && [ -s "$out.tmp" ]; then
+    mv "$out.tmp" "$out"
+    log "$name OK"
+    return 0
+  fi
+  rm -f "$out.tmp"
+  log "$name FAILED"
+  return 1
+}
+
 while true; do
   if timeout 90 python - <<'EOF' 2>/tmp/tpu_health_err.log
 import jax, jax.numpy as jnp
@@ -11,17 +30,27 @@ x = jnp.ones((256, 256), jnp.bfloat16)
 print("TPU OK", jax.devices())
 EOF
   then
-    echo "$(date -Is) tunnel UP — running bench" >> /tmp/tpu_watchdog.log
-    timeout 1800 python bench.py > /tmp/bench_out.json 2>/tmp/bench_err.log
-    rc=$?
-    if [ $rc -eq 0 ] && [ -s /tmp/bench_out.json ]; then
-      cp /tmp/bench_out.json /root/repo/BENCH_interim.json
-      echo "$(date -Is) bench OK" >> /tmp/tpu_watchdog.log
+    log "tunnel UP — running measurement battery"
+    ok=1
+    run_member bench artifacts/bench_r05_interim.json 1800 \
+      python bench.py || ok=0
+    if [ -s artifacts/bench_r05_interim.json ]; then
+      cp artifacts/bench_r05_interim.json BENCH_interim.json
+    fi
+    run_member profile artifacts/profile_decode_r05.txt 1800 \
+      python scripts/profile_decode.py || ok=0
+    run_member moe artifacts/bench_moe_decode_r05.json 1800 \
+      python scripts/bench_moe_decode.py || ok=0
+    run_member flash_prefill artifacts/bench_flash_prefill_r05.txt 2400 \
+      python scripts/bench_flash_prefill.py || ok=0
+    run_member long_context artifacts/bench_long_context_r05.json 2400 \
+      python scripts/bench_long_context.py || ok=0
+    if [ "$ok" = 1 ]; then
+      log "battery COMPLETE"
       exit 0
     fi
-    echo "$(date -Is) bench rc=$rc" >> /tmp/tpu_watchdog.log
   else
-    echo "$(date -Is) tunnel down" >> /tmp/tpu_watchdog.log
+    log "tunnel down"
   fi
   sleep 120
 done

@@ -321,3 +321,16 @@ def test_phi3_longrope_matches_hf(tmp_path):
     assert app.spec.rope.scaling_type == "longrope"
     assert app.spec.rope.original_max_position == 64
     assert app.spec.rope.long_factor == (1.5,) * d2
+
+
+def test_ministral_matches_hf(tmp_path):
+    from transformers import MinistralConfig, MinistralForCausalLM
+    torch.manual_seed(0)
+    cfg = MinistralConfig(hidden_size=64, intermediate_size=128,
+                          num_hidden_layers=3, num_attention_heads=4,
+                          num_key_value_heads=2, vocab_size=256,
+                          sliding_window=8, head_dim=16,
+                          max_position_embeddings=128,
+                          torch_dtype="float32")
+    app = _check(tmp_path, "ministral", MinistralForCausalLM(cfg))
+    assert app.spec.sliding_window == 8

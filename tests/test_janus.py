@@ -1,0 +1,70 @@
+"""Janus understanding-path golden: SigLIP-style encoder + aligner +
+llama text vs HF (reference: contrib/models/Janus-1.3B)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.janus import (
+    JanusApplication, JanusInferenceConfig)
+
+IMG_TOK = 60
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import JanusConfig, JanusForConditionalGeneration
+    torch.manual_seed(0)
+    cfg = JanusConfig(
+        text_config=dict(hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, vocab_size=128,
+                         max_position_embeddings=128, rms_norm_eps=1e-5,
+                         tie_word_embeddings=False, torch_dtype="float32"),
+        vision_config=dict(hidden_size=32, num_hidden_layers=2,
+                           num_attention_heads=2, image_size=16,
+                           patch_size=4, hidden_act="gelu",
+                           mlp_ratio=2.0, projection_dim=64,
+                           depth=2, torch_dtype="float32"),
+        image_token_id=IMG_TOK)
+    m = JanusForConditionalGeneration(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("janus")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def test_janus_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    rng = np.random.default_rng(0)
+    n_img = (16 // 4) ** 2          # 16 patch tokens
+    row = [1] + [IMG_TOK] * n_img + rng.integers(2, 50, 6).tolist()
+    ids = np.stack([row, row]).astype(np.int64)
+    ids[1, -6:] = rng.integers(2, 50, 6)
+    pixels = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+
+    tcfg = TpuConfig(batch_size=2, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = JanusInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        image_token_id=IMG_TOK, model_type="janus")
+    app = JanusApplication(d, icfg).load_weights().init_cache()
+
+    with torch.no_grad():
+        hf_emb = m.model.get_image_features(torch.tensor(pixels)).numpy()
+    got = np.asarray(app.encode_images(pixels))
+    np.testing.assert_allclose(got, hf_emb, atol=2e-4, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_seq = m.generate(input_ids=torch.tensor(ids),
+                            pixel_values=torch.tensor(pixels),
+                            max_new_tokens=8, do_sample=False,
+                            generation_mode="text").numpy()
+    res = app.generate(ids.astype(np.int32), pixel_values=pixels,
+                       max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
+
+    with pytest.raises(NotImplementedError):
+        app.generate_images()
