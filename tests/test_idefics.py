@@ -1,0 +1,118 @@
+"""IDEFICS golden: CLIP tower + perceiver resampler + gated cross-attention
+llama vs HF (reference: contrib/models/idefics-9b-instruct)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.idefics import (
+    IdeficsApplication, IdeficsInferenceConfig)
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import IdeficsConfig, IdeficsForVisionText2Text
+    torch.manual_seed(0)
+    cfg = IdeficsConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, vocab_size=128, cross_layer_interval=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        additional_vocab_size=0, use_resampler=True,
+        vision_config=dict(embed_dim=32, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           image_size=16, patch_size=4,
+                           intermediate_size=64, hidden_act="gelu",
+                           torch_dtype="float32"),
+        perceiver_config=dict(use_resampler=True, resampler_n_latents=4,
+                              resampler_depth=2, resampler_n_heads=2,
+                              resampler_head_dim=16,
+                              qk_layer_norms_perceiver=False),
+        qk_layer_norms=False, torch_dtype="float32")
+    m = IdeficsForVisionText2Text(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("idefics")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def test_idefics_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    rng = np.random.default_rng(0)
+    b, s, n_img = 2, 14, 1
+    ids = rng.integers(2, 120, size=(b, s)).astype(np.int64)
+    pixels = rng.normal(size=(b, n_img, 3, 16, 16)).astype(np.float32)
+    # every token attends the (single) image
+    img_attn = np.ones((b, s, n_img), np.int64)
+
+    tcfg = TpuConfig(batch_size=b, seq_len=48, dtype="float32",
+                     output_logits=True, enable_bucketing=False)
+    icfg = IdeficsInferenceConfig(
+        tcfg, model_type="idefics", **{
+            k: getattr(cfg, k) for k in (
+                "hidden_size", "intermediate_size", "num_hidden_layers",
+                "num_attention_heads", "vocab_size", "cross_layer_interval",
+                "rms_norm_eps", "additional_vocab_size", "use_resampler",
+                "qk_layer_norms", "max_position_embeddings")},
+        vision_config=cfg.vision_config.to_dict(),
+        perceiver_config=cfg.perceiver_config.to_dict())
+    app = IdeficsApplication(d, icfg).load_weights().init_cache()
+
+    # image latents golden: vision tower + perceiver
+    with torch.no_grad():
+        vis = m.model.vision_model(
+            torch.tensor(pixels.reshape(-1, 3, 16, 16))).last_hidden_state
+        hf_lat = m.model.perceiver_resampler(vis).numpy()
+    got_lat, s_img = app.encode_images(pixels)
+    np.testing.assert_allclose(
+        np.asarray(got_lat).reshape(hf_lat.shape), hf_lat,
+        atol=2e-4, rtol=1e-3)
+
+    with torch.no_grad():
+        hf_out = m.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.ones((b, s), dtype=torch.long),
+            pixel_values=torch.tensor(pixels),
+            image_attention_mask=torch.tensor(img_attn),
+            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixel_values=pixels,
+                       image_attention_mask=img_attn, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_out)
+
+
+def test_idefics_partial_two_image_mask(hf_model_and_dir):
+    """Two images with a PARTIAL mask (each token attends only one image)
+    pins HF's gate semantics: gate = attends-at-least-one, partial masks
+    apply to the cross scores."""
+    m, cfg, d = hf_model_and_dir
+    rng = np.random.default_rng(1)
+    b, s, n_img = 1, 10, 2
+    ids = rng.integers(2, 120, size=(b, s)).astype(np.int64)
+    pixels = rng.normal(size=(b, n_img, 3, 16, 16)).astype(np.float32)
+    img_attn = np.zeros((b, s, n_img), np.int64)
+    img_attn[:, :5, 0] = 1          # first half attends image 0
+    img_attn[:, 5:, 1] = 1          # second half attends image 1
+
+    tcfg = TpuConfig(batch_size=b, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = IdeficsInferenceConfig(
+        tcfg, model_type="idefics", **{
+            k: getattr(cfg, k) for k in (
+                "hidden_size", "intermediate_size", "num_hidden_layers",
+                "num_attention_heads", "vocab_size", "cross_layer_interval",
+                "rms_norm_eps", "additional_vocab_size", "use_resampler",
+                "qk_layer_norms", "max_position_embeddings")},
+        vision_config=cfg.vision_config.to_dict(),
+        perceiver_config=cfg.perceiver_config.to_dict())
+    app = IdeficsApplication(d, icfg).load_weights().init_cache()
+
+    with torch.no_grad():
+        hf_out = m.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.ones((b, s), dtype=torch.long),
+            pixel_values=torch.tensor(pixels),
+            image_attention_mask=torch.tensor(img_attn),
+            max_new_tokens=6, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), pixel_values=pixels,
+                       image_attention_mask=img_attn, max_new_tokens=6)
+    np.testing.assert_array_equal(res["sequences"], hf_out)
