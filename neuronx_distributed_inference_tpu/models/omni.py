@@ -1,0 +1,268 @@
+"""Qwen2.5-Omni THINKER — audio + text understanding (reference:
+contrib/models/Qwen2.5-Omni-7B, which validated the text backbone only;
+this implementation also ships the audio tower with an HF golden,
+exceeding the reference's verified surface).
+
+Audio tower (HF Qwen2_5OmniAudioEncoder): mel features are cut into
+``n_window*2``-frame chunks, each chunk runs the two-conv gelu stem with
+sinusoidal positions restarting per chunk, attention is BIDIRECTIONAL
+within a chunk only (here: a chunk-id equality mask over the flattened
+token sequence — the mask-based form of HF's cu_seqlens blocks), then
+each audio's tokens are avg-pooled 2x, layer-normed and projected to the
+text width. The thinker text stack is qwen2 + M-RoPE; audio-only prompts
+use plain sequential positions (HF get_rope_index else-branch), so the
+features merge through the generic image_embeds/image_mask path of the
+text application. Video understanding is not implemented (raises).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig
+from ..ops.normalization import layer_norm
+from ..utils import checkpoint as ckpt
+from .application import CausalLMApplication
+from .family import register_family
+from .qwen2.modeling_qwen2 import Qwen2Family
+from .whisper.modeling_whisper import sinusoidal_positions
+
+
+class OmniThinkerInferenceConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["text_config", "audio_config", "audio_token_id"]
+
+    def get_text_config(self):
+        tc = dict(self.text_config)
+        return OmniThinkerTextFamily.config_cls(self.tpu_config, **tc)
+
+
+@register_family("qwen2_5_omni_text", "qwen2_5_omni_thinker_text")
+class OmniThinkerTextFamily(Qwen2Family):
+    """Thinker text decoder = qwen2 + mrope sections via rope_scaling."""
+
+
+def audio_encoder_forward(params: Dict[str, Any], chunks: jnp.ndarray,
+                          frame_valid: jnp.ndarray, chunk_valid: jnp.ndarray,
+                          n_heads: int, eps: float = 1e-5) -> jnp.ndarray:
+    """chunks (N_chunks, mel, W2) right-padded mel chunks; frame_valid
+    (N_chunks, W2) bool marks live MEL frames (HF zeroes padded frames
+    between the convs); chunk_valid (N_chunks, W2//2) bool marks live
+    post-conv tokens. Returns per-token states (N_chunks, W2//2, D) BEFORE
+    the per-audio pool/proj tail — the host gathers valid tokens and
+    applies the tail per audio."""
+    w = params["conv1_w"]            # (D, mel, 3)
+    x = jax.lax.conv_general_dilated(
+        chunks, w, (1,), [(1, 1)],
+        dimension_numbers=("NCH", "OIH", "NCH")) + params["conv1_b"][:, None]
+    x = jax.nn.gelu(x, approximate=False)
+    x = x * frame_valid[:, None, :].astype(x.dtype)
+    x = jax.lax.conv_general_dilated(
+        x, params["conv2_w"], (2,), [(1, 1)],
+        dimension_numbers=("NCH", "OIH", "NCH")) + params["conv2_b"][:, None]
+    x = jax.nn.gelu(x, approximate=False)
+    x = x.transpose(0, 2, 1)                        # (N, T, D)
+    x = x + params["pos"][: x.shape[1]][None]
+    n, t, d = x.shape
+    hd = d // n_heads
+
+    # attention is block-diagonal by construction (cu_seqlens chunks), so
+    # keep the (n, t) chunk-batch layout — per-chunk attention does n x
+    # fewer score FLOPs than flattening to one (n*t)^2 problem
+    mask = chunk_valid[:, None, :] & chunk_valid[:, :, None]   # (N, T, T)
+    seq = x
+    for lw in params["layers"]:
+        r = layer_norm(seq, lw["ln1_w"], lw["ln1_b"], eps)
+        q = (r @ lw["q_w"] + lw["q_b"]).reshape(n, t, n_heads, hd)
+        k = (r @ lw["k_w"]).reshape(n, t, n_heads, hd)
+        v = (r @ lw["v_w"] + lw["v_b"]).reshape(n, t, n_heads, hd)
+        s = jnp.einsum("nqhd,nkhd->nhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        s = jnp.where(mask[:, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("nhqk,nkhd->nqhd", p, v.astype(jnp.float32))
+        seq = seq + (a.reshape(n, t, d).astype(seq.dtype) @ lw["o_w"]
+                     + lw["o_b"])
+        r = layer_norm(seq, lw["ln2_w"], lw["ln2_b"], eps)
+        m = jax.nn.gelu(r @ lw["fc1_w"] + lw["fc1_b"], approximate=False)
+        seq = seq + m @ lw["fc2_w"] + lw["fc2_b"]
+    return seq
+
+
+def convert_audio_encoder(sd, n_layers: int, max_pos: int, d_model: int,
+                          prefix="thinker.audio_tower"):
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    def lw(i):
+        b = f"layers.{i}"
+        return {
+            "ln1_w": get(f"{b}.self_attn_layer_norm.weight"),
+            "ln1_b": get(f"{b}.self_attn_layer_norm.bias"),
+            "q_w": t(get(f"{b}.self_attn.q_proj.weight")),
+            "q_b": get(f"{b}.self_attn.q_proj.bias"),
+            "k_w": t(get(f"{b}.self_attn.k_proj.weight")),
+            "v_w": t(get(f"{b}.self_attn.v_proj.weight")),
+            "v_b": get(f"{b}.self_attn.v_proj.bias"),
+            "o_w": t(get(f"{b}.self_attn.out_proj.weight")),
+            "o_b": get(f"{b}.self_attn.out_proj.bias"),
+            "ln2_w": get(f"{b}.final_layer_norm.weight"),
+            "ln2_b": get(f"{b}.final_layer_norm.bias"),
+            "fc1_w": t(get(f"{b}.fc1.weight")),
+            "fc1_b": get(f"{b}.fc1.bias"),
+            "fc2_w": t(get(f"{b}.fc2.weight")),
+            "fc2_b": get(f"{b}.fc2.bias"),
+        }
+
+    return {
+        "conv1_w": get("conv1.weight"), "conv1_b": get("conv1.bias"),
+        "conv2_w": get("conv2.weight"), "conv2_b": get("conv2.bias"),
+        "pos": sinusoidal_positions(max_pos, d_model),
+        "layers": [lw(i) for i in range(n_layers)],
+        "ln_post_w": get("ln_post.weight"), "ln_post_b": get("ln_post.bias"),
+        "proj_w": t(get("proj.weight")), "proj_b": get("proj.bias"),
+    }
+
+
+class OmniThinkerApplication:
+    """Audio tower + qwen2/M-RoPE text LM (video raises)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: OmniThinkerInferenceConfig, mesh=None):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.text = CausalLMApplication(model_path, config.get_text_config(),
+                                        OmniThinkerTextFamily, mesh=mesh)
+        ac = dict(config.audio_config)
+        self.d_model = int(ac["d_model"])
+        self.n_heads = int(ac["encoder_attention_heads"])
+        self.n_layers = int(ac["encoder_layers"])
+        self.n_window = int(ac.get("n_window", 100))
+        self.max_pos = int(ac.get("max_source_positions", 1500))
+        self.audio_token_id = int(config.audio_token_id)
+        self.audio_params = None
+        self._enc = jax.jit(partial(audio_encoder_forward,
+                                    n_heads=self.n_heads))
+
+
+    def load_weights(self):
+        sd = ckpt.load_state_dict(self.model_path)
+        text_sd = {}
+        for k, v in sd.items():
+            for pre, new in (("thinker.model.", "model."),
+                             ("thinker.lm_head.", "lm_head."),
+                             ("model.language_model.", "model."),
+                             ("model.audio_tower.", "thinker.audio_tower.")):
+                if k.startswith(pre):
+                    text_sd[new + k[len(pre):]] = v
+                    break
+            else:
+                text_sd[k] = v
+        host = self.text.family.convert_hf_state_dict(text_sd,
+                                                      self.text.spec)
+        self.text._put_params(host)
+        prefix = ("thinker.audio_tower" if any(
+            k.startswith("thinker.audio_tower.") for k in text_sd)
+            else "audio_tower")
+        src = text_sd if prefix.startswith("thinker") else sd
+        ap = convert_audio_encoder(src, self.n_layers, self.max_pos,
+                                   self.d_model, prefix=prefix)
+        self.audio_params = jax.tree.map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x, ap)
+        return self
+
+    def init_cache(self):
+        self.text.init_cache()
+        return self
+
+    def encode_audio(self, input_features: np.ndarray,
+                     feature_lens: np.ndarray) -> List[np.ndarray]:
+        """input_features (N_audio, mel, T_max) mel spectrograms;
+        feature_lens (N_audio,) true mel lengths. Returns one
+        (n_tokens_i, H_text) array per audio (n_tokens = after-conv
+        length // 2, HF avg-pool tail)."""
+        w2 = self.n_window * 2
+        chunks, fvalids, valids, owner = [], [], [], []
+        for a in range(input_features.shape[0]):
+            L = int(feature_lens[a])
+            n_chunks = -(-L // w2)
+            for c in range(n_chunks):
+                lo = c * w2
+                n_frames = min(w2, L - lo)
+                seg = input_features[a, :, lo:lo + n_frames]
+                pad = w2 - seg.shape[1]
+                if pad:
+                    seg = np.pad(seg, ((0, 0), (0, pad)))
+                chunks.append(seg)
+                fvalids.append(np.arange(w2) < n_frames)
+                valids.append(np.arange(w2 // 2) < -(-n_frames // 2))
+                owner.append(a)
+        chunks = np.stack(chunks).astype(np.float32)
+        fvalids = np.stack(fvalids)
+        valids = np.stack(valids)
+        states = np.asarray(self._enc(self.audio_params,
+                                      jnp.asarray(chunks),
+                                      jnp.asarray(fvalids),
+                                      jnp.asarray(valids)))
+        ap = self.audio_params
+        outs = []
+        owner = np.asarray(owner)
+        for a in range(input_features.shape[0]):
+            toks = np.concatenate(
+                [states[i][valids[i]] for i in np.nonzero(owner == a)[0]])
+            n2 = toks.shape[0] // 2
+            pooled = toks[: n2 * 2].reshape(n2, 2, -1).mean(axis=1)
+            h = np.asarray(layer_norm(jnp.asarray(pooled),
+                                      ap["ln_post_w"], ap["ln_post_b"],
+                                      1e-5))
+            outs.append(h @ np.asarray(ap["proj_w"])
+                        + np.asarray(ap["proj_b"]))
+        return outs
+
+    def generate(self, input_ids: np.ndarray,
+                 input_features: Optional[np.ndarray] = None,
+                 feature_lens: Optional[np.ndarray] = None,
+                 attention_mask: Optional[np.ndarray] = None,
+                 max_new_tokens: int = 32, **kw) -> Dict[str, Any]:
+        """input_ids contain ``audio_token_id`` placeholders (one per
+        post-pool audio token); input_features (N_audio, mel, T) with one
+        audio per batch row (multi-audio rows: flatten upstream)."""
+        input_ids = np.asarray(input_ids)
+        b, s = input_ids.shape
+        audio_embeds = audio_mask = None
+        if input_features is not None:
+            feats = self.encode_audio(np.asarray(input_features),
+                                      np.asarray(feature_lens))
+            audio_mask = input_ids == self.audio_token_id
+            per_row = audio_mask.sum(axis=1)
+            if not (per_row == per_row[0]).all():
+                raise ValueError("rows must hold equal audio-token counts")
+            if len(feats) != b:
+                raise ValueError(
+                    f"{len(feats)} audios for {b} prompt rows (one audio "
+                    "per row; flatten multi-audio rows upstream)")
+            stacked = np.stack(feats)
+            if stacked.shape[1] != per_row[0]:
+                raise ValueError(
+                    f"prompt holds {per_row[0]} audio tokens per row but "
+                    f"the encoder emitted {stacked.shape[1]}")
+            audio_embeds = stacked
+        if self.text.cache is None:
+            self.text.init_cache()
+        return self.text.generate(
+            input_ids, attention_mask=attention_mask,
+            max_new_tokens=max_new_tokens,
+            image_embeds=audio_embeds, image_mask=audio_mask, **kw)
+
+    def reset(self):
+        self.text.reset()
+        return self

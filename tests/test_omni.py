@@ -1,0 +1,95 @@
+"""Qwen2.5-Omni thinker golden: chunked-window audio encoder + qwen2 text
+vs HF (reference: contrib/models/Qwen2.5-Omni-7B — text-backbone-only
+there; the audio tower here is golden-verified)."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.omni import (
+    OmniThinkerApplication, OmniThinkerInferenceConfig)
+
+AUDIO_TOK = 90
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_dir(tmp_path_factory):
+    from transformers import (Qwen2_5OmniThinkerConfig,
+                              Qwen2_5OmniThinkerForConditionalGeneration)
+    torch.manual_seed(0)
+    cfg = Qwen2_5OmniThinkerConfig(
+        text_config=dict(hidden_size=64, intermediate_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2, vocab_size=128,
+                         rope_scaling={"type": "default",
+                                       "mrope_section": [2, 3, 3]},
+                         rope_theta=10000.0, max_position_embeddings=256,
+                         rms_norm_eps=1e-5, tie_word_embeddings=False,
+                         torch_dtype="float32"),
+        audio_config=dict(d_model=32, encoder_layers=2,
+                          encoder_attention_heads=2, encoder_ffn_dim=64,
+                          num_mel_bins=16, n_window=4, output_dim=64,
+                          max_source_positions=64, scale_embedding=False,
+                          torch_dtype="float32"),
+        vision_config=dict(depth=1, hidden_size=32, num_heads=2,
+                           out_hidden_size=64, intermediate_size=48,
+                           patch_size=4, spatial_merge_size=2,
+                           temporal_patch_size=2, in_channels=3,
+                           torch_dtype="float32"),
+        audio_token_id=AUDIO_TOK, image_token_id=91, video_token_id=92,
+        audio_start_token_id=93, audio_end_token_id=94,
+        vision_start_token_id=95, vision_end_token_id=96,
+        position_id_per_seconds=25, seconds_per_chunk=2)
+    m = Qwen2_5OmniThinkerForConditionalGeneration(cfg)
+    m.eval()
+    d = tmp_path_factory.mktemp("omni")
+    m.save_pretrained(d, safe_serialization=True)
+    return m, cfg, str(d)
+
+
+def test_omni_thinker_audio_matches_hf(hf_model_and_dir):
+    m, cfg, d = hf_model_and_dir
+    rng = np.random.default_rng(0)
+    # 2 audios of 20 mel frames: chunks of n_window*2=8 -> 8,8,4 frames;
+    # after conv /2 -> 4+4+2 = 10 tokens; avg-pool /2 -> 5 audio tokens
+    n_mel, T = 16, 20
+    feats = rng.normal(size=(2, n_mel, T)).astype(np.float32) * 0.5
+    lens = np.array([T, T], np.int64)
+
+    b = 2
+    row = [1, 93] + [AUDIO_TOK] * 5 + [94] + rng.integers(
+        2, 80, 5).tolist()
+    ids = np.stack([row, row]).astype(np.int64)
+    ids[1, -5:] = rng.integers(2, 80, 5)
+
+    tcfg = TpuConfig(batch_size=b, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = OmniThinkerInferenceConfig(
+        tcfg, model_type="qwen2_5_omni",
+        text_config=cfg.text_config.to_dict(),
+        audio_config=cfg.audio_config.to_dict(),
+        audio_token_id=AUDIO_TOK)
+    app = OmniThinkerApplication(d, icfg).load_weights().init_cache()
+
+    # audio tower golden
+    with torch.no_grad():
+        hf_audio = m.audio_tower(
+            torch.tensor(np.concatenate([feats[0], feats[1]], axis=1)),
+            feature_lens=torch.tensor(lens),
+            aftercnn_lens=torch.tensor([10, 10])).last_hidden_state.numpy()
+    got = np.concatenate(app.encode_audio(feats, lens))
+    np.testing.assert_allclose(got, hf_audio, atol=3e-4, rtol=1e-3)
+
+    # e2e greedy generation with merged audio features
+    fam = np.ones((2, T), np.int64)
+    with torch.no_grad():
+        hf_seq = m.generate(
+            input_ids=torch.tensor(ids),
+            input_features=torch.tensor(
+                np.stack([feats[0], feats[1]])).permute(0, 1, 2),
+            feature_attention_mask=torch.tensor(fam),
+            max_new_tokens=8, do_sample=False).numpy()
+    res = app.generate(ids.astype(np.int32), input_features=feats,
+                       feature_lens=lens, max_new_tokens=8)
+    np.testing.assert_array_equal(res["sequences"], hf_seq)
