@@ -1,0 +1,230 @@
+"""Wav2Vec2 audio frame classifier (reference: contrib/models/
+LaughterSegmentation — a Wav2Vec2-based per-frame laughter classifier).
+
+Covers both HF variants: feat_extract_norm "group" (base: GroupNorm on the
+first conv layer only) / "layer" (large: LayerNorm after every conv), and
+do_stable_layer_norm False (post-LN encoder) / True (pre-LN). The
+positional convolution's weight-norm parametrization is reconstructed at
+conversion (w = g * v / ||v|| per kernel slot)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import InferenceConfig
+from ..ops.normalization import layer_norm
+from ..utils import checkpoint as ckpt
+
+
+@dataclass(frozen=True)
+class Wav2Vec2Spec:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    intermediate_size: int
+    conv_dim: Tuple[int, ...]
+    conv_kernel: Tuple[int, ...]
+    conv_stride: Tuple[int, ...]
+    pos_kernel: int
+    pos_groups: int
+    feat_norm: str = "group"         # "group" | "layer"
+    stable_ln: bool = False          # pre-LN encoder (wav2vec2-large)
+    num_labels: int = 2
+    eps: float = 1e-5
+
+
+def spec_from_hf(cfg) -> Wav2Vec2Spec:
+    g = lambda k, d=None: getattr(cfg, k, d) if not isinstance(cfg, dict) \
+        else cfg.get(k, d)
+    return Wav2Vec2Spec(
+        hidden_size=int(g("hidden_size")),
+        num_layers=int(g("num_hidden_layers")),
+        num_heads=int(g("num_attention_heads")),
+        intermediate_size=int(g("intermediate_size")),
+        conv_dim=tuple(int(x) for x in g("conv_dim")),
+        conv_kernel=tuple(int(x) for x in g("conv_kernel")),
+        conv_stride=tuple(int(x) for x in g("conv_stride")),
+        pos_kernel=int(g("num_conv_pos_embeddings", 128)),
+        pos_groups=int(g("num_conv_pos_embedding_groups", 16)),
+        feat_norm=str(g("feat_extract_norm", "group")),
+        stable_ln=bool(g("do_stable_layer_norm", False)),
+        num_labels=int(g("num_labels", 2)),
+        eps=float(g("layer_norm_eps", 1e-5)),
+    )
+
+
+def _conv1d(x, w, b=None, stride=1, pad=0, groups=1):
+    """x (B, C, T), w (O, I/g, K)."""
+    y = jax.lax.conv_general_dilated(
+        x, w, (stride,), [(pad, pad)], feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"))
+    if b is not None:
+        y = y + b[:, None]
+    return y
+
+
+def wav2vec2_forward(spec: Wav2Vec2Spec, params, waveform: jnp.ndarray
+                     ) -> jnp.ndarray:
+    """waveform (B, T_samples) -> frame logits (B, T_frames, num_labels)."""
+    x = waveform[:, None, :]                       # (B, 1, T)
+    for i, (k, s) in enumerate(zip(spec.conv_kernel, spec.conv_stride)):
+        lw = params["conv_layers"][i]
+        x = _conv1d(x, lw["w"], lw.get("b"), stride=s)
+        if spec.feat_norm == "group" and i == 0:
+            # GroupNorm(groups == channels): per-channel instance norm
+            mu = x.mean(axis=2, keepdims=True)
+            var = x.var(axis=2, keepdims=True)
+            x = (x - mu) * jax.lax.rsqrt(var + spec.eps)
+            x = x * lw["ln_w"][:, None] + lw["ln_b"][:, None]
+        elif spec.feat_norm == "layer":
+            x = layer_norm(x.transpose(0, 2, 1), lw["ln_w"], lw["ln_b"],
+                           spec.eps).transpose(0, 2, 1)
+        x = jax.nn.gelu(x, approximate=False)
+    x = x.transpose(0, 2, 1)                       # (B, T, C_last)
+
+    x = layer_norm(x, params["proj_ln_w"], params["proj_ln_b"], spec.eps)
+    x = x @ params["proj_w"] + params["proj_b"]
+
+    # positional conv (weight-norm reconstructed at load); HF trims the
+    # last output when the kernel is even
+    pos = _conv1d(x.transpose(0, 2, 1), params["pos_w"], params["pos_b"],
+                  pad=spec.pos_kernel // 2, groups=spec.pos_groups)
+    if spec.pos_kernel % 2 == 0:
+        pos = pos[:, :, :-1]
+    x = x + jax.nn.gelu(pos, approximate=False).transpose(0, 2, 1)
+    if not spec.stable_ln:
+        x = layer_norm(x, params["enc_ln_w"], params["enc_ln_b"], spec.eps)
+
+    nh = spec.num_heads
+    hd = spec.hidden_size // nh
+    b, t, d = x.shape
+    for lw in params["layers"]:
+        r = (layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.eps)
+             if spec.stable_ln else x)
+        q = (r @ lw["q_w"] + lw["q_b"]).reshape(b, t, nh, hd)
+        k = (r @ lw["k_w"] + lw["k_b"]).reshape(b, t, nh, hd)
+        v = (r @ lw["v_w"] + lw["v_b"]).reshape(b, t, nh, hd)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * (hd ** -0.5)
+        p = jax.nn.softmax(s, axis=-1)
+        a = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        x = x + (a.reshape(b, t, d).astype(x.dtype) @ lw["o_w"] + lw["o_b"])
+        if not spec.stable_ln:
+            x = layer_norm(x, lw["ln1_w"], lw["ln1_b"], spec.eps)
+        r = (layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.eps)
+             if spec.stable_ln else x)
+        m = jax.nn.gelu(r @ lw["fc1_w"] + lw["fc1_b"], approximate=False)
+        x = x + m @ lw["fc2_w"] + lw["fc2_b"]
+        if not spec.stable_ln:
+            x = layer_norm(x, lw["ln2_w"], lw["ln2_b"], spec.eps)
+    if spec.stable_ln:
+        x = layer_norm(x, params["enc_ln_w"], params["enc_ln_b"], spec.eps)
+    return x @ params["cls_w"] + params["cls_b"]
+
+
+def convert_wav2vec2(sd, spec: Wav2Vec2Spec, prefix="wav2vec2"):
+    def get(n):
+        return np.asarray(sd[f"{prefix}.{n}"], np.float32)
+
+    def t(w):
+        return np.ascontiguousarray(np.asarray(w, np.float32).T)
+
+    conv_layers = []
+    for i in range(len(spec.conv_kernel)):
+        lw = {"w": get(f"feature_extractor.conv_layers.{i}.conv.weight")}
+        bias_key = f"{prefix}.feature_extractor.conv_layers.{i}.conv.bias"
+        if bias_key in sd:          # conv_bias=True (wav2vec2-large)
+            lw["b"] = np.asarray(sd[bias_key], np.float32)
+        if (spec.feat_norm == "layer"
+                or (spec.feat_norm == "group" and i == 0)):
+            lw["ln_w"] = get(f"feature_extractor.conv_layers.{i}"
+                             ".layer_norm.weight")
+            lw["ln_b"] = get(f"feature_extractor.conv_layers.{i}"
+                             ".layer_norm.bias")
+        conv_layers.append(lw)
+
+    # weight-norm: w[o, i, k] = g[0, 0, k] * v[o, i, k] / ||v[:, :, k]||
+    base = "encoder.pos_conv_embed.conv"
+    if f"{prefix}.{base}.parametrizations.weight.original0" in sd:
+        g_key, v_key = (f"{base}.parametrizations.weight.original0",
+                        f"{base}.parametrizations.weight.original1")
+    else:                       # older checkpoints: weight_g / weight_v
+        g_key, v_key = f"{base}.weight_g", f"{base}.weight_v"
+    g0, v = get(g_key), get(v_key)
+    norm = np.sqrt((v ** 2).sum(axis=(0, 1), keepdims=True))
+    pos_w = v * (g0 / np.maximum(norm, 1e-12))
+
+    def enc_layer(i):
+        p = f"encoder.layers.{i}"
+        return {
+            "q_w": t(get(f"{p}.attention.q_proj.weight")),
+            "q_b": get(f"{p}.attention.q_proj.bias"),
+            "k_w": t(get(f"{p}.attention.k_proj.weight")),
+            "k_b": get(f"{p}.attention.k_proj.bias"),
+            "v_w": t(get(f"{p}.attention.v_proj.weight")),
+            "v_b": get(f"{p}.attention.v_proj.bias"),
+            "o_w": t(get(f"{p}.attention.out_proj.weight")),
+            "o_b": get(f"{p}.attention.out_proj.bias"),
+            "ln1_w": get(f"{p}.layer_norm.weight"),
+            "ln1_b": get(f"{p}.layer_norm.bias"),
+            "fc1_w": t(get(f"{p}.feed_forward.intermediate_dense.weight")),
+            "fc1_b": get(f"{p}.feed_forward.intermediate_dense.bias"),
+            "fc2_w": t(get(f"{p}.feed_forward.output_dense.weight")),
+            "fc2_b": get(f"{p}.feed_forward.output_dense.bias"),
+            "ln2_w": get(f"{p}.final_layer_norm.weight"),
+            "ln2_b": get(f"{p}.final_layer_norm.bias"),
+        }
+
+    return {
+        "conv_layers": conv_layers,
+        "proj_ln_w": get("feature_projection.layer_norm.weight"),
+        "proj_ln_b": get("feature_projection.layer_norm.bias"),
+        "proj_w": t(get("feature_projection.projection.weight")),
+        "proj_b": get("feature_projection.projection.bias"),
+        "pos_w": pos_w, "pos_b": get(f"{base}.bias"),
+        "enc_ln_w": get("encoder.layer_norm.weight"),
+        "enc_ln_b": get("encoder.layer_norm.bias"),
+        "layers": [enc_layer(i) for i in range(spec.num_layers)],
+        "cls_w": t(np.asarray(sd["classifier.weight"], np.float32)),
+        "cls_b": np.asarray(sd["classifier.bias"], np.float32),
+    }
+
+
+class Wav2Vec2FrameClassifierConfig(InferenceConfig):
+    def get_required_attributes(self) -> List[str]:
+        return ["hidden_size", "num_hidden_layers", "num_attention_heads",
+                "conv_dim", "conv_kernel", "conv_stride"]
+
+    def get_text_config(self):
+        return self
+
+
+class Wav2Vec2FrameClassifierApplication:
+    """Per-frame audio classifier (LaughterSegmentation-style serving)."""
+
+    def __init__(self, model_path: Optional[str],
+                 config: Wav2Vec2FrameClassifierConfig, mesh=None):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.model_path = model_path
+        self.spec = spec_from_hf(config)
+        self.params = None
+        self._fwd = jax.jit(partial(wav2vec2_forward, self.spec))
+
+    def load_weights(self):
+        sd = ckpt.load_state_dict(self.model_path)
+        self.params = jax.tree.map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+            convert_wav2vec2(sd, self.spec))
+        return self
+
+    def predict(self, waveform: np.ndarray) -> np.ndarray:
+        """(B, T_samples) float waveform -> (B, T_frames, num_labels)."""
+        return np.asarray(self._fwd(self.params, jnp.asarray(
+            np.asarray(waveform, np.float32))))

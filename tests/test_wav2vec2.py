@@ -1,0 +1,80 @@
+"""Wav2Vec2 frame-classifier golden (reference: contrib/models/
+LaughterSegmentation): both HF norm variants vs torch."""
+
+import numpy as np
+import pytest
+import torch
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.wav2vec2 import (
+    Wav2Vec2FrameClassifierApplication, Wav2Vec2FrameClassifierConfig)
+
+
+@pytest.mark.parametrize("variant", ["base", "stable"])
+def test_wav2vec2_frame_classifier_matches_hf(tmp_path, variant):
+    from transformers import (Wav2Vec2Config,
+                              Wav2Vec2ForAudioFrameClassification)
+    torch.manual_seed(0)
+    stable = variant == "stable"
+    cfg = Wav2Vec2Config(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        intermediate_size=64, conv_dim=(16, 16), conv_kernel=(10, 3),
+        conv_stride=(5, 2), num_feat_extract_layers=2,
+        num_conv_pos_embeddings=16, num_conv_pos_embedding_groups=2,
+        num_labels=2, do_stable_layer_norm=stable,
+        feat_extract_norm="layer" if stable else "group",
+        hidden_dropout=0.0, attention_dropout=0.0, feat_proj_dropout=0.0,
+        final_dropout=0.0, layerdrop=0.0, apply_spec_augment=False,
+        torch_dtype="float32")
+    m = Wav2Vec2ForAudioFrameClassification(cfg)
+    m.eval()
+    d = tmp_path / f"w2v2_{variant}"
+    m.save_pretrained(d, safe_serialization=True)
+
+    rng = np.random.default_rng(0)
+    wav = rng.normal(size=(2, 400)).astype(np.float32) * 0.1
+    with torch.no_grad():
+        want = m(torch.tensor(wav)).logits.numpy()
+
+    from neuronx_distributed_inference_tpu.config import \
+        load_pretrained_config
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Wav2Vec2FrameClassifierConfig(
+        tcfg, load_config=load_pretrained_config(str(d)))
+    app = Wav2Vec2FrameClassifierApplication(str(d), icfg).load_weights()
+    got = app.predict(wav)
+    np.testing.assert_allclose(got, want, atol=3e-4, rtol=1e-3)
+
+
+def test_wav2vec2_conv_bias_variant(tmp_path):
+    """conv_bias=True (wav2vec2-large convention) must load and apply the
+    feature-extractor conv biases."""
+    from transformers import (Wav2Vec2Config,
+                              Wav2Vec2ForAudioFrameClassification)
+    torch.manual_seed(2)
+    cfg = Wav2Vec2Config(
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+        intermediate_size=64, conv_dim=(16, 16), conv_kernel=(10, 3),
+        conv_stride=(5, 2), num_feat_extract_layers=2, conv_bias=True,
+        num_conv_pos_embeddings=16, num_conv_pos_embedding_groups=2,
+        num_labels=2, do_stable_layer_norm=True, feat_extract_norm="layer",
+        hidden_dropout=0.0, attention_dropout=0.0, feat_proj_dropout=0.0,
+        final_dropout=0.0, layerdrop=0.0, apply_spec_augment=False,
+        torch_dtype="float32")
+    m = Wav2Vec2ForAudioFrameClassification(cfg)
+    m.eval()
+    d = tmp_path / "w2v2_bias"
+    m.save_pretrained(d, safe_serialization=True)
+    rng = np.random.default_rng(2)
+    wav = rng.normal(size=(1, 300)).astype(np.float32) * 0.1
+    with torch.no_grad():
+        want = m(torch.tensor(wav)).logits.numpy()
+    from neuronx_distributed_inference_tpu.config import \
+        load_pretrained_config
+    tcfg = TpuConfig(batch_size=1, seq_len=64, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Wav2Vec2FrameClassifierConfig(
+        tcfg, load_config=load_pretrained_config(str(d)))
+    app = Wav2Vec2FrameClassifierApplication(str(d), icfg).load_weights()
+    np.testing.assert_allclose(app.predict(wav), want, atol=3e-4, rtol=1e-3)
