@@ -65,7 +65,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--quantization-dtype", default="int8",
                      choices=["int8", "fp8", "mxfp4"])
     run.add_argument("--quantization-type", default="per_channel_symmetric",
-                     choices=["per_channel_symmetric", "per_tensor_symmetric"])
+                     choices=["per_channel_symmetric", "per_tensor_symmetric",
+                              "blockwise_symmetric"])
+    run.add_argument("--moe-tkg-ep-degree", type=int, default=None,
+                     help="hybrid CTE/TKG expert sharding: 1 = decode "
+                          "all-experts-local (reference "
+                          "HybridShardingConfig)")
     run.add_argument("--kv-cache-dtype", default=None)
     run.add_argument("--kv-cache-quant", action="store_true")
     # paged KV / prefix caching / chunked prefill
@@ -117,7 +122,7 @@ def _force_cpu(n: int = 8):
 def run_inference(args) -> int:
     if args.on_cpu:
         _force_cpu(max(args.tp_degree, 8))
-    from .config import (InferenceConfig, LoraServingConfig,
+    from .config import (InferenceConfig, LoraServingConfig, MoEConfig,
                          OnDeviceSamplingConfig, SpeculationConfig, TpuConfig,
                          load_pretrained_config)
     from .models.application import (CausalLMApplication,
@@ -170,6 +175,8 @@ def run_inference(args) -> int:
             is_chunked_prefill=args.chunked_prefill,
             pa_block_size=args.pa_block_size,
             lora_config=lora_cfg,
+            moe_config=(MoEConfig(moe_tkg_ep_degree=args.moe_tkg_ep_degree)
+                        if args.moe_tkg_ep_degree is not None else None),
             output_logits=args.check_accuracy_mode == "logit-matching",
             compile_cache_dir=args.compiled_model_path, seed=args.seed)
         kw.update(over)

@@ -77,14 +77,15 @@ def wav2vec2_forward(spec: Wav2Vec2Spec, params, waveform: jnp.ndarray
         lw = params["conv_layers"][i]
         x = _conv1d(x, lw["w"], lw.get("b"), stride=s)
         if spec.feat_norm == "group" and i == 0:
-            # GroupNorm(groups == channels): per-channel instance norm
+            # GroupNorm(groups == channels): per-channel instance norm;
+            # torch hardcodes eps=1e-5 here regardless of layer_norm_eps
             mu = x.mean(axis=2, keepdims=True)
             var = x.var(axis=2, keepdims=True)
-            x = (x - mu) * jax.lax.rsqrt(var + spec.eps)
+            x = (x - mu) * jax.lax.rsqrt(var + 1e-5)
             x = x * lw["ln_w"][:, None] + lw["ln_b"][:, None]
         elif spec.feat_norm == "layer":
             x = layer_norm(x.transpose(0, 2, 1), lw["ln_w"], lw["ln_b"],
-                           spec.eps).transpose(0, 2, 1)
+                           1e-5).transpose(0, 2, 1)
         x = jax.nn.gelu(x, approximate=False)
     x = x.transpose(0, 2, 1)                       # (B, T, C_last)
 
@@ -199,7 +200,8 @@ def convert_wav2vec2(sd, spec: Wav2Vec2Spec, prefix="wav2vec2"):
 class Wav2Vec2FrameClassifierConfig(InferenceConfig):
     def get_required_attributes(self) -> List[str]:
         return ["hidden_size", "num_hidden_layers", "num_attention_heads",
-                "conv_dim", "conv_kernel", "conv_stride"]
+                "intermediate_size", "conv_dim", "conv_kernel",
+                "conv_stride"]
 
     def get_text_config(self):
         return self
@@ -213,8 +215,20 @@ class Wav2Vec2FrameClassifierApplication:
         self.config = config
         self.tpu_config = config.tpu_config
         self.model_path = model_path
+        if getattr(config, "use_weighted_layer_sum", False):
+            raise NotImplementedError(
+                "use_weighted_layer_sum checkpoints (SUPERB convention) "
+                "classify from a learned sum over ALL layer outputs — not "
+                "implemented; only last-hidden-state heads are supported")
         self.spec = spec_from_hf(config)
         self.params = None
+        # OPT-IN sample-length buckets bound the compile count for
+        # variable-length serving. Default 1 = exact (no padding): the
+        # feature extractor's time-axis GroupNorm folds padding into every
+        # frame's statistics, so padded inference matches HF's
+        # padded-batch semantics, not the unpadded single-audio result —
+        # callers choose the trade-off explicitly.
+        self.sample_bucket = int(getattr(config, "sample_bucket", 1))
         self._fwd = jax.jit(partial(wav2vec2_forward, self.spec))
 
     def load_weights(self):
@@ -224,7 +238,24 @@ class Wav2Vec2FrameClassifierApplication:
             convert_wav2vec2(sd, self.spec))
         return self
 
+    def _frames_for(self, n_samples: int) -> int:
+        t = n_samples
+        for k, s in zip(self.spec.conv_kernel, self.spec.conv_stride):
+            t = (t - k) // s + 1
+        return t
+
     def predict(self, waveform: np.ndarray) -> np.ndarray:
-        """(B, T_samples) float waveform -> (B, T_frames, num_labels)."""
-        return np.asarray(self._fwd(self.params, jnp.asarray(
-            np.asarray(waveform, np.float32))))
+        """(B, T_samples) float waveform -> (B, T_frames, num_labels).
+
+        With ``sample_bucket`` > 1 (serving), waveforms are right-padded
+        to a bucket multiple so new lengths reuse compiled graphs; frames
+        are trimmed to the TRUE length's count, and the numerics match HF
+        on the PADDED batch (the time-axis GroupNorm sees the padding) —
+        exact-match single-audio inference keeps the default bucket 1."""
+        wav = np.asarray(waveform, np.float32)
+        t = wav.shape[1]
+        pad = (-t) % self.sample_bucket
+        if pad:
+            wav = np.pad(wav, ((0, 0), (0, pad)))
+        out = np.asarray(self._fwd(self.params, jnp.asarray(wav)))
+        return out[:, : self._frames_for(t)]

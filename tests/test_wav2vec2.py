@@ -78,3 +78,40 @@ def test_wav2vec2_conv_bias_variant(tmp_path):
         tcfg, load_config=load_pretrained_config(str(d)))
     app = Wav2Vec2FrameClassifierApplication(str(d), icfg).load_weights()
     np.testing.assert_allclose(app.predict(wav), want, atol=3e-4, rtol=1e-3)
+
+
+def test_wav2vec2_sample_bucket_matches_hf_padded(tmp_path):
+    """sample_bucket>1 must reproduce HF run on the SAME padded input
+    (the serving trade-off the knob documents)."""
+    from transformers import (Wav2Vec2Config,
+                              Wav2Vec2ForAudioFrameClassification)
+    torch.manual_seed(3)
+    cfg = Wav2Vec2Config(
+        hidden_size=32, num_hidden_layers=1, num_attention_heads=2,
+        intermediate_size=64, conv_dim=(16, 16), conv_kernel=(10, 3),
+        conv_stride=(5, 2), num_feat_extract_layers=2,
+        num_conv_pos_embeddings=16, num_conv_pos_embedding_groups=2,
+        num_labels=2, do_stable_layer_norm=False, feat_extract_norm="group",
+        hidden_dropout=0.0, attention_dropout=0.0, feat_proj_dropout=0.0,
+        final_dropout=0.0, layerdrop=0.0, apply_spec_augment=False,
+        torch_dtype="float32")
+    m = Wav2Vec2ForAudioFrameClassification(cfg)
+    m.eval()
+    d = tmp_path / "w2v2_bucket"
+    m.save_pretrained(d, safe_serialization=True)
+    rng = np.random.default_rng(3)
+    wav = rng.normal(size=(1, 400)).astype(np.float32) * 0.1
+    padded = np.pad(wav, ((0, 0), (0, 512 - 400)))
+    with torch.no_grad():
+        want = m(torch.tensor(padded)).logits.numpy()
+    from neuronx_distributed_inference_tpu.config import \
+        load_pretrained_config
+    tcfg = TpuConfig(batch_size=1, seq_len=64, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Wav2Vec2FrameClassifierConfig(
+        tcfg, load_config=load_pretrained_config(str(d)),
+        sample_bucket=512)
+    app = Wav2Vec2FrameClassifierApplication(str(d), icfg).load_weights()
+    got = app.predict(wav)                # padded to 512 internally
+    n = got.shape[1]
+    np.testing.assert_allclose(got, want[:, :n], atol=3e-4, rtol=1e-3)
