@@ -151,10 +151,40 @@ def main():
         h1 = min(t(f1) for _ in range(2))
         h2 = min(t(f2) for _ in range(2))
         head_ms = max((h2 - h1) / 48 * 1e3, 0.0)
+
+        # sampling-only slope: sample_dp over a fixed logits tensor
+        from neuronx_distributed_inference_tpu.ops import \
+            sampling as sampling_ops
+
+        def make_samp(n):
+            def samp_loop(lg):
+                def body(c, _):
+                    tok = sampling_ops.sample_dp(lg + c * 0.0, None, None,
+                                                 jax.random.PRNGKey(0))
+                    return c + tok.sum().astype(jnp.float32) * 1e-9, None
+                c, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), None,
+                                    length=n)
+                return c
+            return jax.jit(samp_loop)
+
+        lg0 = jnp.zeros((batch, app.spec.padded_vocab), jnp.float32)
+        s1, s2 = make_samp(16), make_samp(64)
+        np.asarray(s1(lg0)); np.asarray(s2(lg0))
+
+        def ts(f):
+            t0 = time.perf_counter()
+            np.asarray(f(lg0))
+            return time.perf_counter() - t0
+        samp_ms = max((min(ts(s2) for _ in range(2))
+                       - min(ts(s1) for _ in range(2))) / 48 * 1e3, 0.0)
         breakdown = {
             "lm_head_ms_per_step": round(head_ms, 3),
-            "layers_plus_sampling_ms_per_step": round(
-                max(per_step * 1e3 - head_ms, 0.0), 3),
+            "sampling_ms_per_step": round(samp_ms, 3),
+            "layers_plus_dispatch_ms_per_step": round(
+                max(per_step * 1e3 - head_ms - samp_ms, 0.0), 3),
+            "attention_slices": "see artifacts/profile_decode_r05.txt "
+                                "(scripts/profile_decode.py full/layers/"
+                                "lm_head/attn decomposition)",
         }
     except Exception as e:  # pragma: no cover - diagnostics only
         breakdown = {"error": str(e)[:120]}
