@@ -106,22 +106,47 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--benchmark-runs", type=int, default=5)
     run.add_argument("--benchmark-report-path",
                      default="benchmark_report.json")
+    # observability: enable the runtime telemetry registry and dump its JSON
+    # snapshot (metrics + request spans) to PATH on exit
+    run.add_argument("--metrics-json", default=None, metavar="PATH",
+                     help="enable runtime telemetry; write the registry "
+                          "snapshot (metrics + spans) as JSON to PATH")
     run.add_argument("--seed", type=int, default=0)
     return p
 
 
 def _force_cpu(n: int = 8):
-    import jax
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", n)
-    except RuntimeError:
-        pass
+    from .compat import force_cpu_devices
+    force_cpu_devices(n)
 
 
 def run_inference(args) -> int:
     if args.on_cpu:
         _force_cpu(max(args.tp_degree, 8))
+    metrics_reg = None
+    if args.metrics_json:
+        from . import telemetry
+        metrics_reg = telemetry.enable()
+    try:
+        return _run_inference(args)
+    finally:
+        if metrics_reg is not None:
+            # never let a bad --metrics-json path shadow the run's own error
+            try:
+                with open(args.metrics_json, "w") as f:
+                    json.dump(metrics_reg.snapshot(), f, indent=2)
+            except OSError as e:
+                logger.error("could not write telemetry snapshot to %s: %s",
+                             args.metrics_json, e)
+            else:
+                line = metrics_reg.stats_line()
+                if line:
+                    logger.info("telemetry: %s", line)
+                logger.info("telemetry snapshot written to %s",
+                            args.metrics_json)
+
+
+def _run_inference(args) -> int:
     from .config import (InferenceConfig, LoraServingConfig, MoEConfig,
                          OnDeviceSamplingConfig, SpeculationConfig, TpuConfig,
                          load_pretrained_config)

@@ -30,6 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import InferenceConfig, TpuConfig
 from ..modules import autobucketing
+from ..telemetry import get_registry
+from ..telemetry import metrics as tmetrics
 from ..modules.kv_cache import KVCacheSpec, cache_pspec, init_cache
 from ..ops.sampling import prepare_sampling_params
 from ..parallel.mesh import AXIS_DP, AXIS_TP, MeshConfig, build_mesh, mesh_from_config
@@ -65,6 +67,12 @@ class CausalLMApplication:
         self.params = None
         self.cache = None
         self._compiled: Dict[Tuple[str, int], Any] = {}
+        # telemetry: None = follow the process-global registry (disabled by
+        # default); assign app.telemetry = reg to pin one. _jit_seen tracks
+        # (kind, bucket, shape) signatures for the recompile counter — it
+        # never feeds the jit cache key itself.
+        self._telemetry_override = None
+        self._jit_seen: set = set()
         self._rng = jax.random.PRNGKey(self.tpu_config.seed)
         self.ctx_buckets = autobucketing.context_encoding_buckets(self.tpu_config)
         self.tkg_buckets = autobucketing.token_generation_buckets(self.tpu_config)
@@ -243,7 +251,7 @@ class CausalLMApplication:
         buckets = self.tkg_buckets
         if len(buckets) <= 1:
             return None
-        return autobucketing.get_target_bucket(buckets, needed)
+        return autobucketing.get_target_bucket(buckets, needed, kind="tkg")
 
     def get_compiled(self, tag: str, bucket=0):
         key = (tag, bucket)
@@ -330,6 +338,60 @@ class CausalLMApplication:
         degrade to GSPMD-propagated-only sharding)."""
         return jax.sharding.set_mesh(self.mesh)
 
+    # -- telemetry (host-boundary only; all no-ops while disabled) ---------
+    @property
+    def telemetry(self):
+        return (self._telemetry_override
+                if self._telemetry_override is not None else get_registry())
+
+    @telemetry.setter
+    def telemetry(self, reg):
+        self._telemetry_override = reg
+
+    def _tel_start(self):
+        """perf_counter() when telemetry is live, else None (the sentinel
+        keeps the disabled path free of timing work AND of the device sync
+        in :meth:`_tel_end`)."""
+        return time.perf_counter() if self.telemetry.enabled else None
+
+    def _tel_end(self, kind: str, t0, out, n_rows: int):
+        """Observe one _run_* call: host-prep (entry → dispatch return) vs
+        device wait (block_until_ready). Runs strictly OUTSIDE traced code;
+        the sync only happens when telemetry is enabled."""
+        if t0 is None:
+            return
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        t1 = time.perf_counter()
+        jax.block_until_ready(out["tokens"])
+        t2 = time.perf_counter()
+        hist = tmetrics.run_seconds_histogram(tel)
+        hist.observe(t1 - t0, kind=kind, part="host")
+        hist.observe(t2 - t1, kind=kind, part="device")
+        tmetrics.device_sampled_rows_counter(tel).inc(n_rows, kind=kind)
+
+    def _note_jit(self, kind: str, bucket, sig):
+        """Recompile accounting: the first time a (kind, bucket, shape)
+        signature runs it is a graph build (trace + XLA compile, or a
+        persistent-cache load); afterwards it is a cache hit. The single
+        most useful "why is serving slow" signal. Signatures are tracked
+        even while telemetry is disabled (one set-add, no syncs) so that
+        enabling the registry after warmup does not misreport every warm
+        graph as a fresh compile."""
+        key = (kind, bucket, sig)
+        seen = key in self._jit_seen
+        if not seen:
+            self._jit_seen.add(key)
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        if seen:
+            tmetrics.jit_cache_hits_counter(tel).inc(kind=kind)
+        else:
+            tmetrics.jit_compiles_counter(tel).inc(kind=kind,
+                                                   bucket=str(bucket))
+
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)
         return k
@@ -357,8 +419,10 @@ class CausalLMApplication:
             # boundary like _run_decode does
             raise ValueError("non-identity seq_ids require "
                              "is_continuous_batching=True")
+        t0 = self._tel_start()
         position_ids = np.broadcast_to(np.arange(s, dtype=np.int32), (b, s))
         fn = self.get_compiled(CONTEXT_ENCODING_MODEL_TAG, s)
+        self._note_jit("prefill", s, (b, s))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
         if self.snapshot.enabled:
@@ -378,6 +442,7 @@ class CausalLMApplication:
                      adapter_ids, self.replacements, image_embeds, image_mask,
                      rope_position_ids, deepstack_embeds)
         self.cache = out["cache"]
+        self._tel_end("prefill", t0, out, b)
         return out
 
     def _run_prefill_windowed(self, input_ids: np.ndarray,
@@ -449,9 +514,11 @@ class CausalLMApplication:
             # silently read the wrong rows — reject at the boundary
             raise ValueError("non-identity seq_ids require "
                              "is_continuous_batching=True")
+        t0 = self._tel_start()
         needed = int(np.max(np.asarray(position_ids))) + input_ids.shape[1]
-        fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG,
-                               self._kv_bucket(needed) or 0)
+        kv_bucket = self._kv_bucket(needed) or 0
+        fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG, kv_bucket)
+        self._note_jit("decode", kv_bucket, input_ids.shape)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
         if self.snapshot.enabled:
@@ -466,6 +533,7 @@ class CausalLMApplication:
                      sampling_params, self._next_rng(), adapter_ids,
                      self.replacements, rope_position_ids)
         self.cache = out["cache"]
+        self._tel_end("decode", t0, out, b * input_ids.shape[1])
         return out
 
     def _run_decode_loop(self, first_tokens: np.ndarray, positions: np.ndarray,
@@ -475,9 +543,11 @@ class CausalLMApplication:
         b = first_tokens.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
+        t0 = self._tel_start()
         needed = int(np.max(np.asarray(positions))) + num_steps
-        fn = self.get_compiled("decode_loop",
-                               (num_steps, self._kv_bucket(needed)))
+        loop_bucket = (num_steps, self._kv_bucket(needed))
+        fn = self.get_compiled("decode_loop", loop_bucket)
+        self._note_jit("decode_loop", loop_bucket, first_tokens.shape)
         if sampling_params is None:
             sampling_params = self._default_sampling_params(b)
         if rope_position_ids is not None:
@@ -489,6 +559,7 @@ class CausalLMApplication:
                      adapter_ids=adapter_ids,
                      rope_position_ids=rope_position_ids)
         self.cache = out["cache"]
+        self._tel_end("decode_loop", t0, out, b * num_steps)
         return out
 
     # ------------------------------------------------------------------
@@ -563,7 +634,7 @@ class CausalLMApplication:
             return merged
 
         pad = autobucketing.get_target_bucket(self.batch_buckets,
-                                              b_in) - b_in
+                                              b_in, kind="batch") - b_in
 
         def _pad0(k, x):
             if not _batchful(k, x):
@@ -657,7 +728,8 @@ class CausalLMApplication:
             bucket = -(-s // wcte) * wcte
         else:
             wcte = None
-            bucket = autobucketing.get_target_bucket(self.ctx_buckets, s)
+            bucket = autobucketing.get_target_bucket(self.ctx_buckets, s,
+                                                     kind="ctx")
         padded = np.zeros((b, bucket), input_ids.dtype)
         padded[:, :s] = input_ids
         padded_img_mask = None
@@ -955,9 +1027,12 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_paged_loop(self, first_tokens, positions, block_table,
                         num_steps: int, sampling_params=None):
+        t0 = self._tel_start()
         key = ("paged_loop", num_steps)
         if key not in self._compiled:
             self._compiled[key] = self._jit_paged_loop(num_steps)
+        self._note_jit("paged_loop", num_steps,
+                       (first_tokens.shape[0], block_table.shape[1]))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 first_tokens.shape[0])
@@ -967,6 +1042,8 @@ class PagedCausalLMApplication(CausalLMApplication):
                 jnp.asarray(positions), jnp.asarray(block_table),
                 sampling_params, self._next_rng())
         self.cache = out["cache"]
+        self._tel_end("paged_loop", t0, out,
+                      first_tokens.shape[0] * num_steps)
         return out
 
     def get_compiled(self, tag: str, bucket: int = 0):
@@ -986,11 +1063,17 @@ class PagedCausalLMApplication(CausalLMApplication):
         live = max((len(self.kv_mgr.tables.get(i, ())) for i in seq_ids),
                    default=1)
         return autobucketing.get_target_bucket(self._bt_buckets,
-                                               max(live, 1))
+                                               max(live, 1),
+                                               kind="block_table")
 
     def _run_paged(self, input_ids, position_ids, slot_mapping, block_table,
                    last_idx, sampling_params=None):
+        t0 = self._tel_start()
         fn = self.get_compiled("paged_forward")
+        # one jitted graph serves every paged call; the shape signature
+        # (prefill width x table width) is what distinguishes compiles
+        self._note_jit("paged", input_ids.shape[1],
+                       (input_ids.shape, block_table.shape))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(input_ids.shape[0])
         with self._mesh_ctx():
@@ -999,6 +1082,7 @@ class PagedCausalLMApplication(CausalLMApplication):
                      jnp.asarray(block_table), jnp.asarray(last_idx),
                      sampling_params, self._next_rng())
         self.cache = out["cache"]
+        self._tel_end("paged", t0, out, input_ids.shape[0])
         return out
 
     def warmup(self):
@@ -1147,7 +1231,8 @@ class PagedCausalLMApplication(CausalLMApplication):
             # was already bucketed when bt was built (_bt_width) — this
             # picks the other axis (reference: 2-D prefix-caching bucket
             # selection, model_wrapper.py:923-1045)
-            bucket = autobucketing.get_target_bucket(self.ctx_buckets, t_max)
+            bucket = autobucketing.get_target_bucket(self.ctx_buckets, t_max,
+                                                     kind="ctx")
             out = _prefill_window(np.zeros((b,), np.int32), bucket,
                                   np.maximum(suffix_lens - 1, 0))
             tokens = np.asarray(out["tokens"]).reshape(b, 1)

@@ -144,6 +144,13 @@ class Gemma3VLApplication:
         per_row = image_mask.sum(axis=1)
         if not (per_row == per_row[0]).all():
             raise ValueError("rows must hold equal image-token counts")
+        n_feat = feats.shape[0] * feats.shape[1]
+        if n_feat != b * per_row[0]:
+            raise ValueError(
+                f"prompt holds {per_row[0]} image tokens per row "
+                f"({b * per_row[0]} total over batch {b}) but the projector "
+                f"emitted {n_feat} mm tokens (check mm_tokens_per_image vs "
+                "the prompt's image-token span)")
         image_embeds = feats.reshape(b, per_row[0], -1)
         if self.text.cache is None:
             self.text.init_cache()

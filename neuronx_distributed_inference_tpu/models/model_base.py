@@ -1453,6 +1453,14 @@ def run_layers_ssm(spec: DecoderSpec, params, cache, hidden, ai,
     if phase not in ("prefill", "decode"):
         raise NotImplementedError(
             f"recurrent stacks do not support the {phase!r} phase")
+    # the SSM residual walk below hard-codes the plain pre-norm shape; a
+    # hybrid family that also sets these spec knobs would run silently wrong
+    if spec.residual_multiplier != 1.0 or spec.sandwich_norm:
+        raise NotImplementedError(
+            "run_layers_ssm implements the plain pre-norm residual shape "
+            f"only (got residual_multiplier={spec.residual_multiplier}, "
+            f"sandwich_norm={spec.sandwich_norm}); teach the SSM layer walk "
+            "these knobs before combining them with a recurrent stack")
     if phase == "decode" and hidden.shape[1] != 1:
         raise NotImplementedError(
             "recurrent stacks decode one token per step (no speculation "
@@ -2121,6 +2129,21 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
                     "hybrid MoE sharding supports moe_tkg_ep_degree=1 "
                     "(all-experts-local decode) only; the mesh fixes other "
                     "degree combinations")
+            if kw.get("quant") is not None:
+                # quantized expert weights keep the stored (prefill) layout
+                # through decode — the per-phase reshard silently does not
+                # happen (scale shapes vary per quant mode). Say so loudly
+                # instead of letting the perf knob be a no-op.
+                logger.warning(
+                    "moe_tkg_ep_degree=1 (tkg_experts_local) has no effect "
+                    "on quantized MoE expert weights: decode keeps the "
+                    "prefill expert sharding (quantized leaves are not "
+                    "re-constrained). Drop the knob or the quantization.")
+                from ..telemetry import get_registry
+                _reg = get_registry()
+                if _reg.enabled:
+                    from ..telemetry.metrics import moe_tkg_degraded_counter
+                    moe_tkg_degraded_counter(_reg).inc()
             kw["moe"] = replace(kw["moe"], tkg_experts_local=True)
     if kw.get("ssm") is not None:
         sc = tcfg.speculation_config

@@ -8,6 +8,9 @@ from __future__ import annotations
 
 from typing import List, Optional
 
+from ..telemetry import get_registry
+from ..telemetry.metrics import bucket_selected_counter
+
 
 def generate_buckets(min_len: int, max_len: int) -> List[int]:
     """Powers-of-2 ladder from min to max, always including max
@@ -48,10 +51,18 @@ def token_generation_buckets(tpu_config) -> List[int]:
     return generate_buckets(128, tpu_config.seq_len)
 
 
-def get_target_bucket(buckets: List[int], length: int) -> int:
-    """Smallest bucket >= length (reference: model_wrapper.py:831-921)."""
+def get_target_bucket(buckets: List[int], length: int,
+                      kind: Optional[str] = None) -> int:
+    """Smallest bucket >= length (reference: model_wrapper.py:831-921).
+
+    ``kind`` tags the selection for telemetry ("ctx"/"tkg"/"batch"/
+    "block_table"); host-side only, a no-op while telemetry is disabled."""
     for b in buckets:
         if b >= length:
+            if kind is not None:
+                reg = get_registry()
+                if reg.enabled:
+                    bucket_selected_counter(reg).inc(kind=kind, bucket=str(b))
             return b
     raise ValueError(f"length {length} exceeds largest bucket {buckets[-1]}")
 

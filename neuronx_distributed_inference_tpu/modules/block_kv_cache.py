@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import AXIS_MP
+from ..telemetry import get_registry, metrics as tmetrics
 
 
 @dataclass(frozen=True)
@@ -333,7 +334,10 @@ def make_block_allocator(num_blocks: int, block_size: int,
 
 class BlockKVCacheManager:
     """Host-side owner: spec + cache pytree + allocator + per-seq block tables
-    (reference: BlockKVCacheManager + the vLLM-facing surface)."""
+    (reference: BlockKVCacheManager + the vLLM-facing surface).
+
+    Telemetry (host-side, no-op while disabled): blocks in-use/total gauges,
+    allocation-failure counter, prefix-cache hit-token counter."""
 
     def __init__(self, spec: BlockKVSpec, mesh: Optional[Mesh] = None,
                  enable_prefix_caching: bool = True):
@@ -344,25 +348,60 @@ class BlockKVCacheManager:
                                               enable_prefix_caching)
         self.tables: Dict[int, List[int]] = {}     # seq_id -> block list
         self.lens: Dict[int, int] = {}
+        self._tel_occupancy()
+
+    def _tel_registry(self):
+        reg = get_registry()
+        return reg if reg.enabled else None
+
+    def _tel_occupancy(self, reg=None):
+        reg = reg if reg is not None else self._tel_registry()
+        if reg is None:
+            return
+        usable = self.spec.num_blocks - 1          # null block excluded
+        tmetrics.kv_blocks_total_gauge(reg).set(usable)
+        # num_free counts free-list + unreferenced prefix-cached residents;
+        # in-use = blocks some live sequence still references
+        tmetrics.kv_blocks_in_use_gauge(reg).set(
+            usable - self.allocator.num_free)
 
     def begin_sequence(self, seq_id: int, token_ids: Sequence[int]
                        ) -> Tuple[List[int], int]:
         if seq_id in self.tables:      # stale table from an unreleased run
             self.end_sequence(seq_id)  # (would otherwise leak its blocks)
-        blocks, cached = self.allocator.allocate(token_ids)
+        reg = self._tel_registry()
+        try:
+            blocks, cached = self.allocator.allocate(token_ids)
+        except RuntimeError:
+            if reg is not None:
+                tmetrics.kv_alloc_failures_counter(reg).inc()
+            raise
         self.tables[seq_id] = blocks
         self.lens[seq_id] = len(token_ids)
+        if reg is not None:
+            if cached:
+                tmetrics.prefix_hit_tokens_counter(reg).inc(cached)
+            self._tel_occupancy(reg)
         return blocks, cached
 
     def grow(self, seq_id: int, n_new: int = 1) -> List[int]:
         self.lens[seq_id] += n_new
-        self.tables[seq_id] = self.allocator.extend(
-            self.tables[seq_id], self.lens[seq_id])
+        try:
+            self.tables[seq_id] = self.allocator.extend(
+                self.tables[seq_id], self.lens[seq_id])
+        except RuntimeError:
+            self.lens[seq_id] -= n_new
+            reg = self._tel_registry()
+            if reg is not None:
+                tmetrics.kv_alloc_failures_counter(reg).inc()
+            raise
+        self._tel_occupancy()
         return self.tables[seq_id]
 
     def end_sequence(self, seq_id: int):
         self.allocator.free(self.tables.pop(seq_id))
         self.lens.pop(seq_id)
+        self._tel_occupancy()
 
     def block_table_array(self, seq_ids: Sequence[int], max_blocks: int
                           ) -> np.ndarray:

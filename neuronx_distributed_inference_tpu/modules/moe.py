@@ -159,27 +159,27 @@ def _sparsemixer_route(moe: MoESpec, logits: jnp.ndarray
             f"sparsemixer routing is defined for top_k=2 (got {moe.top_k})")
     eps = moe.sparsemixer_eps
 
-    def pick(scores):
+    def pick(scores, ref):
+        """One sparsemixer selection over ``scores``. The jitter threshold is
+        measured against — and the |.| stabilizer taken from — ``ref``, the
+        ORIGINAL logits (HF keeps ``scores.abs()`` across both passes), while
+        the max/argmax/softmax all run on ``scores``. Taking both as
+        parameters (no closure reads) keeps any call site honest about which
+        tensor plays which role."""
         mx = jnp.max(scores, axis=-1, keepdims=True)
-        factor = jnp.maximum(jnp.abs(logits), mx)
-        masked = jnp.where((mx - logits) / factor > 2 * eps, -jnp.inf, scores)
+        factor = jnp.maximum(jnp.abs(ref), mx)
+        masked = jnp.where((mx - ref) / factor > 2 * eps, -jnp.inf, scores)
         idx = jnp.argmax(scores, axis=-1)
         gates = jax.nn.softmax(masked, axis=-1)
         val = jnp.take_along_axis(gates, idx[..., None], axis=-1)
         return val[..., 0], idx
 
-    v1, i1 = pick(logits)
+    v1, i1 = pick(logits, logits)
+    # second pass: mask out the winner, re-pick over the remainder (threshold
+    # vs the REMAINING max, stabilizer still |original logits|)
     masked_scores = jnp.where(
         jax.nn.one_hot(i1, logits.shape[-1], dtype=bool), -jnp.inf, logits)
-    # second pass: threshold is measured against the REMAINING max but
-    # factor still uses the original logits (HF keeps `scores.abs()`)
-    mx2 = jnp.max(masked_scores, axis=-1, keepdims=True)
-    factor2 = jnp.maximum(jnp.abs(logits), mx2)
-    masked2 = jnp.where((mx2 - logits) / factor2 > 2 * eps, -jnp.inf,
-                        masked_scores)
-    i2 = jnp.argmax(masked_scores, axis=-1)
-    g2 = jax.nn.softmax(masked2, axis=-1)
-    v2 = jnp.take_along_axis(g2, i2[..., None], axis=-1)[..., 0]
+    v2, i2 = pick(masked_scores, logits)
     return (jnp.stack([v1, v2], axis=-1),
             jnp.stack([i1, i2], axis=-1).astype(jnp.int32))
 

@@ -20,12 +20,15 @@ Works over either application:
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .modules import autobucketing
+from .telemetry import get_registry
+from .telemetry import metrics as tmetrics
 
 
 @dataclass
@@ -33,6 +36,92 @@ class _SeqState:
     position: int                 # position of last_token
     last_token: int
     running: bool = True
+
+
+class _AdapterTelemetry:
+    """Shared engine-adapter instrumentation: TTFT / per-step decode latency
+    histograms, live-batch + pad-waste accounting, one request span per
+    seq_id. Host-side only (measures at the adapter boundary — the device
+    fetch has already happened when these run); every method is a cheap
+    no-op while telemetry is disabled."""
+
+    def __init__(self, engine: str, telemetry=None):
+        self.engine = engine
+        self._telemetry = telemetry
+        self._requests: Dict[int, Dict[str, Any]] = {}
+
+    @property
+    def registry(self):
+        return self._telemetry if self._telemetry is not None \
+            else get_registry()
+
+    def on_add(self, seq_ids: Sequence[int], prompts, t0: float,
+               live: int, padded: int):
+        reg = self.registry
+        if not reg.enabled:
+            return
+        ttft = time.perf_counter() - t0
+        hist = tmetrics.ttft_histogram(reg)
+        for sid, prompt in zip(seq_ids, prompts):
+            span = reg.start_span("request", engine=self.engine, seq_id=sid)
+            span.t_start = t0
+            span.event("first_token", ttft_s=ttft, prompt_len=len(prompt))
+            self._requests[sid] = {"span": span, "steps": 0,
+                                   "t_first": t0 + ttft, "t_last": t0 + ttft}
+            hist.observe(ttft, engine=self.engine)
+        tmetrics.requests_counter(reg).inc(len(seq_ids), engine=self.engine,
+                                           event="added")
+        tmetrics.generated_tokens_counter(reg).inc(live, engine=self.engine)
+        self._rows(reg, "prefill", live, padded)
+
+    def on_step(self, live_ids: Sequence[int], t0: float, padded: int):
+        reg = self.registry
+        if not reg.enabled:
+            return
+        now = time.perf_counter()
+        tmetrics.decode_step_histogram(reg).observe(now - t0,
+                                                    engine=self.engine)
+        tmetrics.generated_tokens_counter(reg).inc(len(live_ids),
+                                                   engine=self.engine)
+        for sid in live_ids:
+            info = self._requests.get(sid)
+            if info is not None:
+                info["steps"] += 1
+                info["t_last"] = now
+        self._rows(reg, "decode", len(live_ids), padded)
+
+    def on_release(self, seq_ids: Sequence[int]):
+        # pop unconditionally: requests admitted while telemetry was live
+        # must not leak from _requests if it is disabled before release
+        reg = self.registry
+        released = 0
+        for sid in seq_ids:
+            info = self._requests.pop(sid, None)
+            if info is None:
+                continue
+            released += 1
+            span, steps = info["span"], info["steps"]
+            span.event("released", decode_steps=steps)
+            if reg.enabled and steps > 0:
+                # first token -> LAST decode step, not -> release: a request
+                # parked finished while the engine drains others must not
+                # inflate its reported per-token latency
+                tmetrics.tpot_histogram(reg).observe(
+                    (info["t_last"] - info["t_first"]) / steps,
+                    engine=self.engine)
+            span.end()
+        if released and reg.enabled:
+            tmetrics.requests_counter(reg).inc(released, engine=self.engine,
+                                               event="released")
+
+    def _rows(self, reg, phase: str, live: int, padded: int):
+        tmetrics.live_batch_gauge(reg).set(live, engine=self.engine)
+        tmetrics.live_rows_counter(reg).inc(live, engine=self.engine,
+                                            phase=phase)
+        if padded > live:
+            tmetrics.pad_rows_counter(reg).inc(padded - live,
+                                               engine=self.engine,
+                                               phase=phase)
 
 
 def _live_rows(seqs: Dict[int, _SeqState],
@@ -63,7 +152,7 @@ class ContinuousBatchingAdapter:
     """vLLM-style engine adapter over the contiguous app
     (reference: model_wrapper.py:1297-1440)."""
 
-    def __init__(self, app):
+    def __init__(self, app, telemetry=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ValueError("app must be built with "
@@ -71,6 +160,7 @@ class ContinuousBatchingAdapter:
         self.app = app
         self.batch = cfg.batch_size
         self.seqs: Dict[int, _SeqState] = {}
+        self.telemetry = _AdapterTelemetry("cb", telemetry)
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -91,10 +181,11 @@ class ContinuousBatchingAdapter:
                 raise ValueError(f"seq_id {sid} out of range [0,{self.batch})")
             if sid in self.seqs:
                 raise ValueError(f"seq_id {sid} already running")
+        t0 = time.perf_counter()
         b = len(seq_ids)
         lens = np.asarray([len(p) for p in prompts], np.int32)
         width = autobucketing.get_target_bucket(self.app.ctx_buckets,
-                                                int(lens.max()))
+                                                int(lens.max()), kind="ctx")
         ids = np.zeros((b, width), np.int32)
         for i, p in enumerate(prompts):
             ids[i, :len(p)] = p
@@ -109,6 +200,7 @@ class ContinuousBatchingAdapter:
             self.seqs[sid] = _SeqState(position=int(lens[i]),
                                        last_token=int(toks[i]))
             res[sid] = int(toks[i])
+        self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
@@ -117,6 +209,7 @@ class ContinuousBatchingAdapter:
         live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
+        t0 = time.perf_counter()
         b = len(live)
         pad_to = self._batch_bucket(b)
         sid = np.asarray(live, np.int32)
@@ -134,18 +227,21 @@ class ContinuousBatchingAdapter:
             st.position += 1
             st.last_token = int(new[i])
             res[s] = int(new[i])
+        self.telemetry.on_step(live, t0, padded=pad_to)
         return res
 
     def release(self, seq_ids: Sequence[int]):
         for sid in seq_ids:
             self.seqs.pop(sid, None)
+        self.telemetry.on_release(seq_ids)
 
     # -- helpers ----------------------------------------------------------
     def _batch_bucket(self, b: int) -> int:
         if b > self.batch:
             raise ValueError(f"live batch {b} exceeds compiled batch "
                              f"{self.batch}")
-        return autobucketing.get_target_bucket(self.app.batch_buckets, b)
+        return autobucketing.get_target_bucket(self.app.batch_buckets, b,
+                                               kind="batch")
 
     @staticmethod
     def _pad_rows(ids: np.ndarray, seq_ids: np.ndarray, pad_to: int):
@@ -162,13 +258,14 @@ class PagedEngineAdapter:
     slot_mapping / active_block_table contract of
     block_kv_cache_manager.py + model_wrapper.py:1297-1313)."""
 
-    def __init__(self, app):
+    def __init__(self, app, telemetry=None):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ValueError("app must be built with is_block_kv_layout=True")
         self.app = app
         self.batch = cfg.batch_size
         self.seqs: Dict[int, _SeqState] = {}
+        self.telemetry = _AdapterTelemetry("paged", telemetry)
 
     def add_requests(self, seq_ids: Sequence[int],
                      prompts: Sequence[Sequence[int]]) -> Dict[int, int]:
@@ -180,6 +277,7 @@ class PagedEngineAdapter:
         for sid in seq_ids:
             if sid in self.seqs:
                 raise ValueError(f"seq_id {sid} already running")
+        t0 = time.perf_counter()
         app = self.app
         b = len(seq_ids)
         lens = np.asarray([len(p) for p in prompts], np.int32)
@@ -188,7 +286,7 @@ class PagedEngineAdapter:
             _, c = app.kv_mgr.begin_sequence(sid, list(prompts[i]))
             cached[i] = min(c, lens[i] - 1)
         width = autobucketing.get_target_bucket(
-            app.ctx_buckets, int((lens - cached).max()))
+            app.ctx_buckets, int((lens - cached).max()), kind="ctx")
         bt = app.kv_mgr.block_table_array(seq_ids, app._bt_width_for(seq_ids))
         ids_w = np.zeros((b, width), np.int32)
         pos_w = np.zeros((b, width), np.int32)
@@ -203,7 +301,8 @@ class PagedEngineAdapter:
         # repad to the compiled batch bucket (repeat row 0 - pad rows
         # rewrite row 0's slots with identical values); without this every
         # distinct live count would jit a fresh graph mid-serving
-        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b)
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
+                                                 kind="batch")
         ids_w, pos_w, slots, bt2, last = _pad_paged_rows(
             pad_to, ids_w, pos_w, slots, bt,
             np.maximum(lens - cached - 1, 0))
@@ -214,6 +313,7 @@ class PagedEngineAdapter:
             self.seqs[sid] = _SeqState(position=int(lens[i]),
                                        last_token=int(toks[i]))
             res[sid] = int(toks[i])
+        self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
@@ -222,6 +322,7 @@ class PagedEngineAdapter:
         live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
+        t0 = time.perf_counter()
         b = len(live)
         toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
         pos = np.asarray([self.seqs[s].position for s in live], np.int32)
@@ -230,7 +331,8 @@ class PagedEngineAdapter:
         bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
         slots = slots_from_table(bt, pos[:, None],
                                  app.kv_mgr.spec.block_size)
-        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b)
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
+                                                 kind="batch")
         ids_p, pos_p, slots_p, bt_p, last_p = _pad_paged_rows(
             pad_to, toks[:, None], pos[:, None], slots, bt,
             np.zeros((b,), np.int32))
@@ -242,6 +344,7 @@ class PagedEngineAdapter:
             st.position += 1
             st.last_token = int(new[i])
             res[s] = int(new[i])
+        self.telemetry.on_step(live, t0, padded=pad_to)
         return res
 
     def release(self, seq_ids: Sequence[int]):
@@ -250,3 +353,4 @@ class PagedEngineAdapter:
                 self.seqs.pop(sid)
                 if sid in self.app.kv_mgr.tables:
                     self.app.kv_mgr.end_sequence(sid)
+        self.telemetry.on_release(seq_ids)

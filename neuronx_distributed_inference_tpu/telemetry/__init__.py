@@ -1,0 +1,30 @@
+"""Runtime telemetry: metrics registry + request spans (serving-grade
+observability, complementing the debug-grade snapshot/profiling tools in
+``utils/``).
+
+Quick start::
+
+    from neuronx_distributed_inference_tpu import telemetry
+    reg = telemetry.enable()          # global registry (default: disabled)
+    ... serve ...
+    print(reg.render_prometheus())    # Prometheus text exposition
+    json.dump(reg.snapshot(), fh)     # JSON snapshot (+ request spans)
+
+Disabled (the library default) every instrument is a shared no-op and the
+instrumented hot paths skip their timing blocks — outputs and jit cache keys
+are bit-identical to an uninstrumented build.
+"""
+
+from . import metrics
+from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, NULL_REGISTRY, NullRegistry, disable,
+                       enable, get_registry, set_registry)
+from .spans import NULL_SPAN, NullSpan, Span
+
+__all__ = [
+    "metrics",
+    "DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "NULL_REGISTRY", "NullRegistry",
+    "enable", "disable", "get_registry", "set_registry",
+    "Span", "NullSpan", "NULL_SPAN",
+]

@@ -1,0 +1,156 @@
+"""Canonical metric names + label sets — a STABLE contract.
+
+Dashboards and tests key on these strings; treat renames as breaking
+changes (README "Observability" documents each one). Helpers here build the
+instruments with their canonical help text/labels so every call site agrees
+on the schema.
+"""
+
+from __future__ import annotations
+
+from .registry import DEFAULT_LATENCY_BUCKETS
+
+# -- serving adapters (serving.py) -----------------------------------------
+# engine label: "cb" (ContinuousBatchingAdapter) | "paged" (PagedEngineAdapter)
+REQUEST_TTFT_SECONDS = "nxdi_request_ttft_seconds"
+DECODE_STEP_SECONDS = "nxdi_decode_step_seconds"      # TPOT per step() call
+REQUEST_TPOT_SECONDS = "nxdi_request_tpot_seconds"    # per-request mean TPOT
+LIVE_BATCH_SIZE = "nxdi_live_batch_size"
+LIVE_ROWS_TOTAL = "nxdi_live_rows_total"              # phase=prefill|decode
+PAD_ROWS_TOTAL = "nxdi_pad_rows_total"                # phase=prefill|decode
+REQUESTS_TOTAL = "nxdi_requests_total"                # event=added|released
+
+# -- application hot paths (models/application.py) --------------------------
+# kind: prefill|decode|decode_loop|paged ; part: host|device
+RUN_SECONDS = "nxdi_run_seconds"
+GENERATED_TOKENS_TOTAL = "nxdi_generated_tokens_total"      # engine=cb|paged
+DEVICE_SAMPLED_ROWS_TOTAL = "nxdi_device_sampled_rows_total"  # kind
+
+# -- jit / bucketing (models/application.py, modules/autobucketing.py) ------
+JIT_COMPILES_TOTAL = "nxdi_jit_compiles_total"        # kind, bucket
+JIT_CACHE_HITS_TOTAL = "nxdi_jit_cache_hits_total"    # kind
+BUCKET_SELECTED_TOTAL = "nxdi_bucket_selected_total"  # kind, bucket
+
+# -- paged KV cache (modules/block_kv_cache.py) ------------------------------
+KV_BLOCKS_TOTAL = "nxdi_kv_blocks_total"
+KV_BLOCKS_IN_USE = "nxdi_kv_blocks_in_use"
+KV_BLOCK_ALLOC_FAILURES_TOTAL = "nxdi_kv_block_alloc_failures_total"
+PREFIX_CACHE_HIT_TOKENS_TOTAL = "nxdi_prefix_cache_hit_tokens_total"
+
+# -- degradations -----------------------------------------------------------
+MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
+    "nxdi_moe_tkg_local_quant_degraded_total"
+
+
+def ttft_histogram(reg):
+    return reg.histogram(
+        REQUEST_TTFT_SECONDS,
+        "Time from request admission to its first generated token (s)",
+        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def decode_step_histogram(reg):
+    return reg.histogram(
+        DECODE_STEP_SECONDS,
+        "Wall time of one engine decode step() call (s)",
+        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def tpot_histogram(reg):
+    return reg.histogram(
+        REQUEST_TPOT_SECONDS,
+        "Per-request mean time-per-output-token after the first token (s)",
+        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def live_batch_gauge(reg):
+    return reg.gauge(LIVE_BATCH_SIZE,
+                     "Live rows submitted in the most recent engine call",
+                     labels=("engine",))
+
+
+def live_rows_counter(reg):
+    return reg.counter(LIVE_ROWS_TOTAL,
+                       "Live (non-pad) rows submitted to the device",
+                       labels=("engine", "phase"))
+
+
+def pad_rows_counter(reg):
+    return reg.counter(
+        PAD_ROWS_TOTAL,
+        "Pad rows submitted to the device (pad-waste = pad/(pad+live))",
+        labels=("engine", "phase"))
+
+
+def requests_counter(reg):
+    return reg.counter(REQUESTS_TOTAL, "Engine request lifecycle events",
+                       labels=("engine", "event"))
+
+
+def run_seconds_histogram(reg):
+    return reg.histogram(
+        RUN_SECONDS,
+        "Application _run_* wall time, split host-prep vs device wait (s)",
+        labels=("kind", "part"), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def generated_tokens_counter(reg):
+    return reg.counter(GENERATED_TOKENS_TOTAL,
+                       "Tokens generated for live requests (engine-observed; "
+                       "excludes pad rows)",
+                       labels=("engine",))
+
+
+def device_sampled_rows_counter(reg):
+    return reg.counter(
+        DEVICE_SAMPLED_ROWS_TOTAL,
+        "Rows sampled per device forward (includes pad rows; the gap to "
+        "nxdi_generated_tokens_total is engine pad waste)",
+        labels=("kind",))
+
+
+def jit_compiles_counter(reg):
+    return reg.counter(
+        JIT_COMPILES_TOTAL,
+        "First-time (kind, bucket, shape) graph builds — each one is a "
+        "trace+compile (or persistent-cache load) stall",
+        labels=("kind", "bucket"))
+
+
+def jit_cache_hits_counter(reg):
+    return reg.counter(JIT_CACHE_HITS_TOTAL,
+                       "Executions that reused an already-built graph",
+                       labels=("kind",))
+
+
+def bucket_selected_counter(reg):
+    return reg.counter(BUCKET_SELECTED_TOTAL,
+                       "Host-side pad-target bucket selections",
+                       labels=("kind", "bucket"))
+
+
+def kv_blocks_total_gauge(reg):
+    return reg.gauge(KV_BLOCKS_TOTAL,
+                     "Usable KV cache blocks (excludes the null block)")
+
+
+def kv_blocks_in_use_gauge(reg):
+    return reg.gauge(KV_BLOCKS_IN_USE,
+                     "KV cache blocks currently referenced by sequences")
+
+
+def kv_alloc_failures_counter(reg):
+    return reg.counter(KV_BLOCK_ALLOC_FAILURES_TOTAL,
+                       "Block allocations that failed (cache exhausted)")
+
+
+def prefix_hit_tokens_counter(reg):
+    return reg.counter(PREFIX_CACHE_HIT_TOKENS_TOTAL,
+                       "Prompt tokens served from the prefix cache")
+
+
+def moe_tkg_degraded_counter(reg):
+    return reg.counter(
+        MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL,
+        "tkg_experts_local requested but quantized expert weights kept the "
+        "prefill layout (decode resharding skipped)")

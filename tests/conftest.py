@@ -16,8 +16,19 @@ import jax  # noqa: E402
 # plugin registered; config.update still wins as long as no backend has been
 # initialized yet.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS fallback above provides the 8 virtual devices
+    pass
 jax.config.update("jax_threefry_partitionable", True)
+
+# older-jax API shims (set_mesh / get_abstract_mesh / shard_map); no-op on
+# current jax — also applied by the package import, kept explicit here
+from neuronx_distributed_inference_tpu.compat import \
+    ensure_jax_compat  # noqa: E402
+
+ensure_jax_compat()
 # fp32 tests compare against torch exactly; don't let matmuls drop precision
 jax.config.update("jax_default_matmul_precision", "highest")
 

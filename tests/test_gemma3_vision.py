@@ -109,3 +109,25 @@ def test_bidir_overlay_changes_image_logits(hf_model_and_dir):
     d1 = np.asarray(r1["logits"][0])[:, 1:5]     # image positions
     d2 = np.asarray(r2["logits"][0])[:, 1:5]
     assert np.abs(d1 - d2).max() > 1e-4
+
+
+def test_feature_token_count_mismatch_raises(hf_model_and_dir):
+    """Regression: a prompt whose image-token span disagrees with the
+    projector's mm-token count must fail with both counts, not an opaque
+    reshape error (mirrors janus.py)."""
+    m, cfg, d = hf_model_and_dir
+    tcfg = TpuConfig(batch_size=1, seq_len=48, dtype="float32",
+                     enable_bucketing=False)
+    icfg = Gemma3VLInferenceConfig(
+        tcfg, text_config=cfg.text_config.to_dict(),
+        vision_config=cfg.vision_config.to_dict(),
+        mm_tokens_per_image=cfg.mm_tokens_per_image,
+        image_token_index=cfg.image_token_index, model_type="gemma3")
+    app = Gemma3VLApplication(d, icfg).load_weights().init_cache()
+    rng = np.random.default_rng(0)
+    # 3 image tokens in the prompt, but the projector emits 4 per image
+    row = [251] + [IMG_TOK] * 3 + [252] + rng.integers(10, 240, 7).tolist()
+    ids = np.asarray([row], np.int32)
+    pixels = rng.normal(size=(1, 3, 16, 16)).astype(np.float32)
+    with pytest.raises(ValueError, match=r"3 image tokens.*4 mm tokens"):
+        app.generate(ids, pixel_values=pixels, max_new_tokens=1)

@@ -180,3 +180,84 @@ def test_moe_hybrid_tkg_sharding_matches(tmp_path):
     np.testing.assert_array_equal(hyb["generated"], base["generated"])
     for a, b in zip(hyb["logits"], base["logits"]):
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=1e-4)
+
+
+def test_sparsemixer_pick_uses_its_parameters(rng):
+    """Regression: the sparsemixer inner pick() once read the closed-over
+    logits instead of its scores argument — correct only by accident for the
+    first pass. Both passes now run through pick(scores, ref); pin the full
+    two-pass semantics against an independent NumPy reference."""
+    spec = _moe_spec(num_experts=8, top_k=2, router_act="sparsemixer")
+    h = rng.normal(size=(2, 3, 16)).astype(np.float32)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    top_vals, top_idx = moe_mod.route(spec, jnp.asarray(h), jnp.asarray(w))
+
+    logits = (h.reshape(-1, 16) @ w).astype(np.float32)
+    eps = spec.sparsemixer_eps
+
+    def ref_pick(scores, ref):
+        mx = scores.max(-1, keepdims=True)
+        factor = np.maximum(np.abs(ref), mx)
+        masked = np.where((mx - ref) / factor > 2 * eps, -np.inf, scores)
+        idx = scores.argmax(-1)
+        e = np.exp(masked - masked.max(-1, keepdims=True))
+        gates = e / e.sum(-1, keepdims=True)
+        return np.take_along_axis(gates, idx[:, None], 1)[:, 0], idx
+
+    v1, i1 = ref_pick(logits, logits)
+    masked_scores = logits.copy()
+    masked_scores[np.arange(len(i1)), i1] = -np.inf
+    v2, i2 = ref_pick(masked_scores, logits)
+
+    np.testing.assert_array_equal(np.asarray(top_idx).reshape(-1, 2),
+                                  np.stack([i1, i2], -1))
+    np.testing.assert_allclose(np.asarray(top_vals).reshape(-1, 2),
+                               np.stack([v1, v2], -1), atol=1e-5)
+
+
+def test_tkg_local_quantized_moe_warns_and_counts(caplog):
+    """Regression: tkg_experts_local silently degrades to the prefill expert
+    layout when the MoE weights are quantized; spec_from_config must say so
+    loudly and bump the degradation telemetry counter."""
+    import logging
+
+    from neuronx_distributed_inference_tpu import telemetry
+    from neuronx_distributed_inference_tpu.config import MoEConfig
+    from neuronx_distributed_inference_tpu.models.mixtral.modeling_mixtral \
+        import MixtralFamily, MixtralInferenceConfig
+    from neuronx_distributed_inference_tpu.telemetry.metrics import \
+        MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL
+
+    hf = dict(model_type="mixtral", hidden_size=64, num_attention_heads=4,
+              num_hidden_layers=2, num_key_value_heads=2, vocab_size=256,
+              intermediate_size=96, rms_norm_eps=1e-5, num_local_experts=4,
+              num_experts_per_tok=2, rope_theta=10000.0,
+              max_position_embeddings=128, hidden_act="silu",
+              tie_word_embeddings=False, torch_dtype="float32")
+
+    def build(quantized):
+        tcfg = TpuConfig(batch_size=1, seq_len=32, dtype="float32",
+                         enable_bucketing=False, quantized=quantized,
+                         moe_config=MoEConfig(moe_tkg_ep_degree=1))
+        return MixtralFamily.build_spec(MixtralInferenceConfig(tcfg, **hf))
+
+    reg = telemetry.MetricsRegistry()
+    telemetry.set_registry(reg)
+    try:
+        with caplog.at_level(logging.WARNING):
+            spec = build(quantized=True)
+    finally:
+        telemetry.disable()
+    assert spec.moe.tkg_experts_local
+    assert any("quantized" in r.getMessage().lower()
+               and "tkg_experts_local" in r.getMessage()
+               for r in caplog.records)
+    assert reg.get(MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL).get() == 1
+
+    # unquantized hybrid stays silent
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        spec = build(quantized=False)
+    assert spec.moe.tkg_experts_local
+    assert not any("tkg_experts_local" in r.getMessage()
+                   for r in caplog.records)

@@ -205,3 +205,31 @@ def test_recurrent_state_carries_across_decode(tmp_path):
     steered = np.asarray(app._run_decode(tok, pos)["logits"])
     assert np.abs(steered - base).max() > 1e-2, \
         "injected SSM state changed nothing — state read path is dead"
+
+
+def test_ssm_layer_walk_rejects_residual_spec_knobs():
+    """Regression guard: run_layers_ssm hard-codes the plain pre-norm
+    residual shape — a hybrid family setting residual_multiplier or
+    sandwich_norm must fail loudly, not run silently wrong."""
+    import dataclasses
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models import model_base
+    from neuronx_distributed_inference_tpu.models.llama import \
+        LlamaInferenceConfig
+    from neuronx_distributed_inference_tpu.modules.ssm import SSMSpec
+
+    from conftest import tiny_llama_hf_config
+
+    tcfg = TpuConfig(batch_size=1, seq_len=32, dtype="float32",
+                     enable_bucketing=False)
+    icfg = LlamaInferenceConfig(tcfg, **tiny_llama_hf_config())
+    spec = model_base.spec_from_config(
+        icfg, ssm=SSMSpec(kind="mamba2", d_inner=64, num_heads=4, head_dim=16,
+                          d_state=16))
+
+    for bad in (dataclasses.replace(spec, residual_multiplier=0.22),
+                dataclasses.replace(spec, sandwich_norm=True)):
+        with pytest.raises(NotImplementedError, match="pre-norm residual"):
+            model_base.run_layers_ssm(bad, None, None, None, None, None,
+                                      None, "prefill")
