@@ -242,6 +242,20 @@ class CausalLMApplication:
                      kv_view=kv_bucket)
         return jax.jit(fn, static_argnames=("num_steps",), donate_argnums=(1,))
 
+    def _check_decode_fits(self, needed: int):
+        """Decode writing KV slots up to ``needed - 1`` must stay inside
+        the compiled seq_len — past it the scatter writes out of bounds
+        (wrapping or dropping silently, depending on layout). Rolling
+        caches store slot = pos % window, so they can never overflow."""
+        if self.spec.rolling_window:
+            return
+        limit = self.tpu_config.seq_len
+        if needed > limit:
+            from ..resilience.errors import CapacityError
+            raise CapacityError(
+                f"decode would write KV at position {needed - 1} past the "
+                f"compiled seq_len {limit}")
+
     def _kv_bucket(self, needed: int) -> Optional[int]:
         """Smallest TKG seq bucket covering ``needed`` cache slots — the
         decode graph compiled for bucket b reads cache[:b] only (reference:
@@ -516,6 +530,7 @@ class CausalLMApplication:
                              "is_continuous_batching=True")
         t0 = self._tel_start()
         needed = int(np.max(np.asarray(position_ids))) + input_ids.shape[1]
+        self._check_decode_fits(needed)
         kv_bucket = self._kv_bucket(needed) or 0
         fn = self.get_compiled(TOKEN_GENERATION_MODEL_TAG, kv_bucket)
         self._note_jit("decode", kv_bucket, input_ids.shape)
@@ -545,6 +560,7 @@ class CausalLMApplication:
             seq_ids = np.arange(b, dtype=np.int32)
         t0 = self._tel_start()
         needed = int(np.max(np.asarray(positions))) + num_steps
+        self._check_decode_fits(needed)
         loop_bucket = (num_steps, self._kv_bucket(needed))
         fn = self.get_compiled("decode_loop", loop_bucket)
         self._note_jit("decode_loop", loop_bucket, first_tokens.shape)

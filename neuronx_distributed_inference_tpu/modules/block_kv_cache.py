@@ -37,6 +37,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import AXIS_MP
+from ..resilience.errors import CapacityError, KVCacheStateError
+from ..resilience.faults import FAULTS as _FAULTS
 from ..telemetry import get_registry, metrics as tmetrics
 
 
@@ -191,7 +193,7 @@ class BlockAllocator:
                 self.hash_to_block.pop(h, None)
             self.meta[blk] = _BlockMeta()
             return blk
-        raise RuntimeError("out of KV cache blocks")
+        raise CapacityError("out of KV cache blocks")
 
     def allocate(self, token_ids: Sequence[int]) -> Tuple[List[int], int]:
         """Allocate blocks for a prompt. Returns (block_ids, cached_tokens).
@@ -218,8 +220,14 @@ class BlockAllocator:
             matching = False                # prefix broken; rest are fresh
             try:
                 blk = self._pop_block()
-            except RuntimeError:
-                self.free(blocks)           # roll back this call
+            except CapacityError:
+                # roll back this call: prefix-HIT blocks keep their valid
+                # hashes; fresh blocks were hashed before their content was
+                # written, so the hashes must go or later allocations would
+                # prefix-"hit" garbage KV
+                n_hit = cached_tokens // self.block_size
+                self.free(blocks[:n_hit])
+                self.invalidate(blocks[n_hit:])
                 raise
             m = self.meta[blk]
             m.ref_count += 1
@@ -239,7 +247,7 @@ class BlockAllocator:
         while len(blocks) + len(added) < need:
             try:
                 blk = self._pop_block()
-            except RuntimeError:
+            except CapacityError:
                 self.free(added)
                 raise
             self.meta[blk].ref_count += 1
@@ -252,12 +260,30 @@ class BlockAllocator:
             m = self.meta[blk]
             m.ref_count -= 1
             if m.ref_count < 0:
-                raise RuntimeError(f"double free of block {blk}")
+                raise KVCacheStateError(f"double free of block {blk}")
             if m.ref_count == 0:
                 if m.content_hash is not None:
                     self._lru.append(blk)  # keep resident for prefix reuse
                 else:
                     self.free_list.append(blk)
+
+    def invalidate(self, blocks: Sequence[int]):
+        """Free blocks whose pending content was never written (aborted
+        admission): drop their hash registration once unreferenced so the
+        prefix cache can never serve them. Blocks still referenced by
+        another sequence keep their hash — that content predates the
+        aborted call and is valid."""
+        for blk in blocks:
+            m = self.meta[blk]
+            m.ref_count -= 1
+            if m.ref_count < 0:
+                raise KVCacheStateError(f"double free of block {blk}")
+            if m.ref_count == 0:
+                if m.content_hash is not None:
+                    if self.hash_to_block.get(m.content_hash) == blk:
+                        del self.hash_to_block[m.content_hash]
+                    m.content_hash = None
+                self.free_list.append(blk)
 
 
 class NativeBlockAllocator:
@@ -274,7 +300,7 @@ class NativeBlockAllocator:
         self._ct = ctypes
         self._lib = native.load_library()
         if self._lib is None:
-            raise RuntimeError("native library unavailable")
+            raise ImportError("native library unavailable")
         self.block_size = block_size
         self.num_blocks = num_blocks
         self.enable_prefix_caching = enable_prefix_caching
@@ -301,7 +327,7 @@ class NativeBlockAllocator:
             self._h, toks.ctypes.data_as(ct.POINTER(ct.c_int64)), len(toks),
             out, max_out, ct.byref(cached))
         if n < 0:
-            raise RuntimeError("out of KV cache blocks")
+            raise CapacityError("out of KV cache blocks")
         return list(out[:n]), int(cached.value)
 
     def extend(self, blocks: List[int], new_len: int) -> List[int]:
@@ -311,14 +337,20 @@ class NativeBlockAllocator:
         n = self._lib.nxdi_alloc_extend(self._h, buf, len(blocks), new_len,
                                         max(need, len(blocks)))
         if n < 0:
-            raise RuntimeError("out of KV cache blocks")
+            raise CapacityError("out of KV cache blocks")
         return list(buf[:n])
 
     def free(self, blocks: Sequence[int]):
         ct = self._ct
         arr = (ct.c_int * len(blocks))(*blocks)
         if self._lib.nxdi_alloc_free(self._h, arr, len(blocks)) < 0:
-            raise RuntimeError("double free of a KV block")
+            raise KVCacheStateError("double free of a KV block")
+
+    def invalidate(self, blocks: Sequence[int]):
+        ct = self._ct
+        arr = (ct.c_int * len(blocks))(*blocks)
+        if self._lib.nxdi_alloc_invalidate(self._h, arr, len(blocks)) < 0:
+            raise KVCacheStateError("double free of a KV block")
 
 
 def make_block_allocator(num_blocks: int, block_size: int,
@@ -348,6 +380,7 @@ class BlockKVCacheManager:
                                               enable_prefix_caching)
         self.tables: Dict[int, List[int]] = {}     # seq_id -> block list
         self.lens: Dict[int, int] = {}
+        self._hit_blocks: Dict[int, int] = {}      # leading prefix-HIT blocks
         self._tel_occupancy()
 
     def _tel_registry(self):
@@ -371,13 +404,16 @@ class BlockKVCacheManager:
             self.end_sequence(seq_id)  # (would otherwise leak its blocks)
         reg = self._tel_registry()
         try:
+            if _FAULTS.active:
+                _FAULTS.fire("paged_alloc")
             blocks, cached = self.allocator.allocate(token_ids)
-        except RuntimeError:
+        except CapacityError:
             if reg is not None:
                 tmetrics.kv_alloc_failures_counter(reg).inc()
             raise
         self.tables[seq_id] = blocks
         self.lens[seq_id] = len(token_ids)
+        self._hit_blocks[seq_id] = cached // self.spec.block_size
         if reg is not None:
             if cached:
                 tmetrics.prefix_hit_tokens_counter(reg).inc(cached)
@@ -387,9 +423,11 @@ class BlockKVCacheManager:
     def grow(self, seq_id: int, n_new: int = 1) -> List[int]:
         self.lens[seq_id] += n_new
         try:
+            if _FAULTS.active:
+                _FAULTS.fire("paged_alloc")
             self.tables[seq_id] = self.allocator.extend(
                 self.tables[seq_id], self.lens[seq_id])
-        except RuntimeError:
+        except CapacityError:
             self.lens[seq_id] -= n_new
             reg = self._tel_registry()
             if reg is not None:
@@ -398,9 +436,44 @@ class BlockKVCacheManager:
         self._tel_occupancy()
         return self.tables[seq_id]
 
+    def shrink(self, seq_id: int, n_tokens: int = 1) -> List[int]:
+        """Inverse of :meth:`grow`: forget the last ``n_tokens`` and free
+        blocks no longer covered. Used to roll a sequence back to its
+        pre-step state when a decode step fails after growth."""
+        if seq_id not in self.tables:
+            raise KVCacheStateError(f"shrink of unknown seq_id {seq_id}")
+        new_len = self.lens[seq_id] - n_tokens
+        if new_len < 0:
+            raise KVCacheStateError(
+                f"shrink below zero for seq_id {seq_id} "
+                f"({self.lens[seq_id]} - {n_tokens})")
+        need = max(1, self.spec.blocks_for(new_len))
+        blocks = self.tables[seq_id]
+        if len(blocks) > need:
+            extra = blocks[need:]
+            del blocks[need:]
+            self.allocator.free(extra)
+        self.lens[seq_id] = new_len
+        self._tel_occupancy()
+        return blocks
+
     def end_sequence(self, seq_id: int):
         self.allocator.free(self.tables.pop(seq_id))
         self.lens.pop(seq_id)
+        self._hit_blocks.pop(seq_id, None)
+        self._tel_occupancy()
+
+    def abort_sequence(self, seq_id: int):
+        """End a sequence admitted by a transaction that failed before (or
+        while) its prefill wrote KV: prefix-HIT blocks — whose content
+        predates the aborted call — are freed normally, but fresh blocks
+        are :meth:`~BlockAllocator.invalidate`\\ d so their never-written
+        contents can never be served as prefix hits."""
+        blocks = self.tables.pop(seq_id)
+        n_hit = self._hit_blocks.pop(seq_id, 0)
+        self.lens.pop(seq_id)
+        self.allocator.free(blocks[:n_hit])
+        self.allocator.invalidate(blocks[n_hit:])
         self._tel_occupancy()
 
     def block_table_array(self, seq_ids: Sequence[int], max_blocks: int

@@ -82,6 +82,10 @@ def load_library() -> Optional[ctypes.CDLL]:
         lib.nxdi_alloc_free.argtypes = [ctypes.c_void_p,
                                         ctypes.POINTER(ctypes.c_int),
                                         ctypes.c_int]
+        lib.nxdi_alloc_invalidate.restype = ctypes.c_int
+        lib.nxdi_alloc_invalidate.argtypes = [ctypes.c_void_p,
+                                              ctypes.POINTER(ctypes.c_int),
+                                              ctypes.c_int]
         lib.nxdi_alloc_num_free.restype = ctypes.c_int
         lib.nxdi_alloc_num_free.argtypes = [ctypes.c_void_p]
         _lib = lib

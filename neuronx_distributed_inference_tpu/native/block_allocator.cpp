@@ -95,8 +95,15 @@ class Allocator {
       matching = false;
       int blk = pop_block();
       if (blk < 0) {
-        // roll back this call's allocations
-        for (int j = 0; j < bi; ++j) release_one(out_blocks[j]);
+        // roll back this call's allocations: prefix-HIT blocks keep their
+        // (valid, pre-existing) hashes; fresh blocks were hashed before
+        // their content was ever written, so their hashes must be purged
+        // or later allocations would "hit" garbage KV
+        int n_hit = cached / block_size_;
+        for (int j = 0; j < bi; ++j) {
+          if (j < n_hit) release_one(out_blocks[j]);
+          else invalidate_one(out_blocks[j]);
+        }
         return -1;
       }
       BlockMeta& m = meta_[blk];
@@ -139,6 +146,17 @@ class Allocator {
     return 0;
   }
 
+  // free blocks whose pending content was never written (aborted
+  // admission): once unreferenced they go back to the free list with
+  // their hash registration dropped, so the prefix cache can never
+  // serve their contents. returns 0 ok, -1 double free
+  int invalidate_blocks(const int* blocks, int n) {
+    for (int i = 0; i < n; ++i) {
+      if (invalidate_one(blocks[i]) < 0) return -1;
+    }
+    return 0;
+  }
+
  private:
   int release_one(int blk) {
     BlockMeta& m = meta_[blk];
@@ -152,6 +170,24 @@ class Allocator {
         free_list_.push_back(blk);
       }
     }
+    return 0;
+  }
+
+  int invalidate_one(int blk) {
+    BlockMeta& m = meta_[blk];
+    m.ref_count -= 1;
+    if (m.ref_count < 0) return -1;
+    if (m.ref_count == 0) {
+      if (m.content_hash != 0) {
+        auto it = hash_to_block_.find(m.content_hash);
+        if (it != hash_to_block_.end() && it->second == blk)
+          hash_to_block_.erase(it);
+        m.content_hash = 0;
+      }
+      free_list_.push_back(blk);
+    }
+    // ref_count > 0: another sequence still references it, so its
+    // content predates the aborted call and stays prefix-servable
     return 0;
   }
 
@@ -207,6 +243,10 @@ int nxdi_alloc_extend(void* a, int* blocks, int n_blocks, int new_len,
 
 int nxdi_alloc_free(void* a, const int* blocks, int n) {
   return static_cast<Allocator*>(a)->free_blocks(blocks, n);
+}
+
+int nxdi_alloc_invalidate(void* a, const int* blocks, int n) {
+  return static_cast<Allocator*>(a)->invalidate_blocks(blocks, n);
 }
 
 int nxdi_alloc_num_free(void* a) {

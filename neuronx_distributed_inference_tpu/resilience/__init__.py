@@ -1,0 +1,24 @@
+"""Serving resilience layer: typed failure taxonomy, deterministic
+fault injection, and recompute-preemption policies.
+
+The serving adapters (``serving.py``) and the paged cache manager
+(``modules/block_kv_cache.py``) raise ONLY exceptions from this taxonomy at
+their public boundaries (enforced by ``scripts/check_error_paths.py``, a
+tier-1 lint). Every recovery path — transactional admission rollback,
+preemption under KV pressure, deadline budgets — is exercised on CPU by
+arming the fault points in :mod:`.faults`; no TPU, no flakiness.
+"""
+
+from .errors import (AdmissionError, CapacityError, ConfigurationError,
+                     DeadlineExceeded, KVCacheStateError, SequenceStateError,
+                     ServingError, StepFailure)
+from .faults import FAULT_POINTS, FAULTS, FaultInjector, InjectedFault
+from .preemption import PREEMPTION_POLICIES, Preempted, pick_victim
+
+__all__ = [
+    "ServingError", "AdmissionError", "CapacityError", "ConfigurationError",
+    "DeadlineExceeded", "KVCacheStateError", "SequenceStateError",
+    "StepFailure",
+    "FAULTS", "FAULT_POINTS", "FaultInjector", "InjectedFault",
+    "Preempted", "PREEMPTION_POLICIES", "pick_victim",
+]

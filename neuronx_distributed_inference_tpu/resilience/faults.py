@@ -1,0 +1,143 @@
+"""Deterministic fault-injection harness for the serving surface.
+
+Named fault points are compiled into the adapters and the paged cache
+manager; arming one makes the Nth traversal of that point fail (or stall)
+deterministically, so every recovery path — admission rollback, preemption,
+deadline expiry, step retry — is exercised by fast CPU tests.
+
+Usage::
+
+    from neuronx_distributed_inference_tpu.resilience import FAULTS
+
+    with FAULTS.inject("paged_alloc", nth=2) as fp:
+        adapter.add_requests([0, 1], [p0, p1])   # 2nd block alloc fails
+    assert fp.trips == 1
+
+Fault points (a STABLE contract, like the telemetry metric names):
+
+  ``paged_alloc``    block allocation in ``BlockKVCacheManager``
+                     (``begin_sequence`` / ``grow``) — default raises
+                     :class:`~.errors.CapacityError`, indistinguishable
+                     from a genuinely exhausted pool
+  ``prefill_step``   the device prefill call inside ``add_requests``
+  ``decode_step``    the device decode call inside ``step()`` — fires
+                     AFTER host-side KV growth, so it proves rollback
+  ``slow_step``      start of ``step()`` — sleeps ``delay_s`` instead of
+                     raising (drives deadline expiry deterministically)
+
+Hot-path cost while nothing is armed: a single attribute check
+(``FAULTS.active``) — no call, no allocation (pinned by
+``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from .errors import CapacityError
+
+__all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
+
+FAULT_POINTS = ("paged_alloc", "prefill_step", "decode_step", "slow_step")
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by an armed step fault point. Deliberately
+    NOT a :class:`~.errors.ServingError`: it models an unexpected low-level
+    failure, which the adapters must wrap into a typed
+    :class:`~.errors.StepFailure` at the boundary."""
+
+
+def _default_exc(point: str) -> Exception:
+    if point == "paged_alloc":
+        # must look exactly like a real pool-dry failure so the recovery
+        # path under test is the production one
+        return CapacityError("out of KV cache blocks (injected fault)")
+    return InjectedFault(f"injected fault at point {point!r}")
+
+
+class FaultPoint:
+    """One arming of one fault point. Context manager: armed on
+    ``__enter__``, disarmed on ``__exit__``. Exposes :attr:`calls` (times
+    the point was traversed while armed) and :attr:`trips` (times the
+    fault actually fired) for test assertions."""
+
+    def __init__(self, injector: "FaultInjector", point: str, nth: int,
+                 times: int, delay_s: Optional[float],
+                 exc_factory: Optional[Callable[[], Exception]]):
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r}; known points: "
+                             f"{FAULT_POINTS}")
+        if nth < 1 or times < 1:
+            raise ValueError("nth and times must be >= 1")
+        self.injector = injector
+        self.point = point
+        self.nth = nth
+        self.times = times
+        self.delay_s = delay_s
+        self.exc_factory = exc_factory
+        self.calls = 0
+        self.trips = 0
+
+    def __enter__(self) -> "FaultPoint":
+        self.injector._arm(self)
+        return self
+
+    def __exit__(self, *exc_info) -> bool:
+        self.injector._disarm(self)
+        return False
+
+    def _hit(self):
+        """Called by the injector on each traversal of the armed point."""
+        self.calls += 1
+        if not (self.nth <= self.calls < self.nth + self.times):
+            return
+        self.trips += 1
+        if self.delay_s is not None:
+            time.sleep(self.delay_s)
+            return
+        raise (self.exc_factory() if self.exc_factory is not None
+               else _default_exc(self.point))
+
+
+class FaultInjector:
+    """Registry of armed fault points. The module-level singleton
+    :data:`FAULTS` is the one the library's call sites consult; tests arm
+    it via :meth:`inject`. At most one arming per point at a time."""
+
+    def __init__(self):
+        self.active = False            # the ONLY thing hot paths read
+        self._armed: Dict[str, FaultPoint] = {}
+
+    def inject(self, point: str, *, nth: int = 1, times: int = 1,
+               delay_s: Optional[float] = None,
+               exc_factory: Optional[Callable[[], Exception]] = None
+               ) -> FaultPoint:
+        """Build a :class:`FaultPoint` arming ``point`` to fire on calls
+        ``nth .. nth+times-1`` (1-based). ``delay_s`` makes it sleep
+        instead of raise; ``exc_factory`` overrides the default exception.
+        Use as a context manager."""
+        return FaultPoint(self, point, nth, times, delay_s, exc_factory)
+
+    def _arm(self, fp: FaultPoint):
+        if fp.point in self._armed:
+            raise RuntimeError(f"fault point {fp.point!r} is already armed")
+        self._armed[fp.point] = fp
+        self.active = True
+
+    def _disarm(self, fp: FaultPoint):
+        if self._armed.get(fp.point) is fp:
+            del self._armed[fp.point]
+        self.active = bool(self._armed)
+
+    def fire(self, point: str):
+        """Traverse ``point``: no-op unless that point is armed. Call
+        sites guard with ``if FAULTS.active:`` so this is never entered
+        in an unarmed process."""
+        fp = self._armed.get(point)
+        if fp is not None:
+            fp._hit()
+
+
+FAULTS = FaultInjector()

@@ -1,0 +1,58 @@
+"""Recompute preemption under KV pressure (vLLM-style RECOMPUTE mode).
+
+When the block pool cannot satisfy an allocation (admission or decode
+growth), the paged adapter evicts the lowest-priority running sequence,
+reclaims its blocks, and hands the engine a :class:`Preempted` record. The
+record's ``tokens`` (prompt + everything generated so far, including the
+not-yet-cached last sample) is re-queued verbatim as a new prompt: under
+greedy sampling the recomputed continuation is bit-identical to an
+uninterrupted run (pinned by ``tests/test_resilience.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Optional, Tuple
+
+__all__ = ["Preempted", "PREEMPTION_POLICIES", "pick_victim"]
+
+#: Victim-selection policies:
+#:   ``lifo``             — evict the most recently admitted sequence (its
+#:                          recompute cost is lowest; vLLM's default)
+#:   ``fewest_generated`` — evict the sequence with the fewest generated
+#:                          tokens (least decode work thrown away), ties
+#:                          broken LIFO
+PREEMPTION_POLICIES = ("lifo", "fewest_generated")
+
+
+@dataclass(frozen=True)
+class Preempted:
+    """One evicted sequence, ready for the engine to re-queue.
+
+    ``tokens`` is the full recompute prompt: original prompt + generated
+    tokens (the last of which had been sampled but not yet written to KV —
+    re-prefilling writes it and samples its successor, exactly as the
+    interrupted decode would have)."""
+
+    seq_id: int
+    tokens: Tuple[int, ...]
+    prompt_len: int
+    n_generated: int
+    reason: str                    # "grow" | "admission"
+
+
+def pick_victim(policy: str,
+                candidates: Iterable[Tuple[int, int, int]]) -> Optional[int]:
+    """Choose the victim seq_id from ``(seq_id, admit_idx, n_generated)``
+    tuples; ``None`` when there are no candidates. ``admit_idx`` is the
+    adapter's monotonic admission counter."""
+    cands = list(candidates)
+    if not cands:
+        return None
+    if policy == "lifo":
+        return max(cands, key=lambda c: c[1])[0]
+    if policy == "fewest_generated":
+        # ties (same generated count) fall back to LIFO
+        return min(cands, key=lambda c: (c[2], -c[1]))[0]
+    raise ValueError(f"unknown preemption policy {policy!r}; expected one "
+                     f"of {PREEMPTION_POLICIES}")

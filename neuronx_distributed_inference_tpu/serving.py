@@ -16,17 +16,38 @@ Works over either application:
   - ``CausalLMApplication`` with ``is_continuous_batching=True`` —
     contiguous cache rows keyed by seq_id;
   - ``PagedCausalLMApplication`` — block tables keyed by seq_id.
+
+Resilience contract (see README "Serving resilience"):
+
+  * every boundary failure is typed (``resilience.errors``) — never a bare
+    ``ValueError``/``RuntimeError`` (enforced by
+    ``scripts/check_error_paths.py``);
+  * ``add_requests`` is **transactional**: it either admits every sequence
+    or rolls back all allocations/adapter state from the call and leaves
+    device + cache state exactly as before;
+  * the paged adapter **preempts** the lowest-priority running sequence
+    when the block pool runs dry (``preemption_policy``: "lifo" /
+    "fewest_generated" / None), handing back :class:`Preempted` records
+    via :meth:`PagedEngineAdapter.take_preempted`;
+  * per-request wall-clock deadlines (``deadline_s``) and a
+    decode-past-``seq_len`` guard bound each request's budget.
 """
 
 from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from .modules import autobucketing
+from .resilience.errors import (AdmissionError, CapacityError,
+                                ConfigurationError, DeadlineExceeded,
+                                SequenceStateError, ServingError, StepFailure)
+from .resilience.faults import FAULTS as _FAULTS
+from .resilience.preemption import (PREEMPTION_POLICIES, Preempted,
+                                    pick_victim)
 from .telemetry import get_registry
 from .telemetry import metrics as tmetrics
 
@@ -36,6 +57,11 @@ class _SeqState:
     position: int                 # position of last_token
     last_token: int
     running: bool = True
+    tokens: List[int] = field(default_factory=list)  # prompt + generated
+    prompt_len: int = 0
+    admit_idx: int = 0            # adapter-wide admission counter (LIFO)
+    deadline: Optional[float] = None   # absolute perf_counter() deadline
+    expired_reported: bool = False     # deadline metric counted once
 
 
 class _AdapterTelemetry:
@@ -114,6 +140,35 @@ class _AdapterTelemetry:
             tmetrics.requests_counter(reg).inc(released, engine=self.engine,
                                                event="released")
 
+    def on_preempt(self, seq_id: int, reason: str):
+        # like on_release, the span is closed unconditionally so a request
+        # preempted after telemetry is disabled cannot leak from _requests
+        info = self._requests.pop(seq_id, None)
+        if info is not None:
+            info["span"].event("preempted", reason=reason)
+            info["span"].end()
+        reg = self.registry
+        if reg.enabled:
+            tmetrics.preemptions_counter(reg).inc(engine=self.engine,
+                                                  reason=reason)
+
+    def on_deadline(self, seq_ids: Sequence[int]):
+        reg = self.registry
+        if seq_ids and reg.enabled:
+            tmetrics.deadline_expired_counter(reg).inc(len(seq_ids),
+                                                       engine=self.engine)
+
+    def on_step_failure(self, phase: str):
+        reg = self.registry
+        if reg.enabled:
+            tmetrics.step_failures_counter(reg).inc(engine=self.engine,
+                                                    phase=phase)
+
+    def on_admission_rollback(self):
+        reg = self.registry
+        if reg.enabled:
+            tmetrics.admission_rollbacks_counter(reg).inc(engine=self.engine)
+
     def _rows(self, reg, phase: str, live: int, padded: int):
         tmetrics.live_batch_gauge(reg).set(live, engine=self.engine)
         tmetrics.live_rows_counter(reg).inc(live, engine=self.engine,
@@ -130,9 +185,72 @@ def _live_rows(seqs: Dict[int, _SeqState],
     if seq_ids is not None:
         for sid in ids:
             if sid not in seqs:
-                raise ValueError(f"seq_id {sid} is not running (released "
-                                 "or never added)")
+                raise SequenceStateError(f"seq_id {sid} is not running "
+                                         "(released or never added)")
     return [sid for sid in ids if seqs[sid].running]
+
+
+def _validate_admission(seq_ids: Sequence[int],
+                        prompts: Sequence[Sequence[int]], seq_len: int):
+    """Reject malformed admissions BEFORE any state changes — an empty
+    batch or a zero-length prompt must fail typed here, not as an opaque
+    numpy ``max()`` crash three layers down."""
+    if len(seq_ids) == 0:
+        raise AdmissionError("add_requests called with empty seq_ids")
+    if len(seq_ids) != len(prompts):
+        raise AdmissionError("seq_ids and prompts length mismatch "
+                             f"({len(seq_ids)} vs {len(prompts)})")
+    if len(set(seq_ids)) != len(seq_ids):
+        raise AdmissionError("duplicate seq_ids in one add_requests call")
+    for sid, p in zip(seq_ids, prompts):
+        if len(p) == 0:
+            raise AdmissionError(f"zero-length prompt for seq_id {sid}")
+        if len(p) > seq_len:
+            raise AdmissionError(
+                f"prompt for seq_id {sid} is {len(p)} tokens — beyond the "
+                f"compiled seq_len {seq_len}")
+
+
+def _resolve_deadlines(deadline_s, n: int,
+                       t0: float) -> List[Optional[float]]:
+    """Per-request absolute deadlines from a scalar (shared) or per-seq
+    sequence of relative wall-clock budgets in seconds."""
+    if deadline_s is None:
+        return [None] * n
+    if isinstance(deadline_s, (int, float)):
+        return [t0 + float(deadline_s)] * n
+    if len(deadline_s) != n:
+        raise AdmissionError("deadline_s and seq_ids length mismatch")
+    return [None if d is None else t0 + float(d) for d in deadline_s]
+
+
+def _pre_step_checks(seqs: Dict[int, _SeqState], live: Sequence[int],
+                     seq_len: Optional[int], telemetry: _AdapterTelemetry):
+    """Per-request budget enforcement, BEFORE any device work or cache
+    growth: wall-clock deadlines, then the decode-past-seq_len guard (a
+    row at position seq_len-1 holds its last representable token — one
+    more step would scatter KV out of bounds). ``seq_len`` is None for
+    rolling-window caches (slot = pos % window never overflows)."""
+    now = time.perf_counter()
+    expired = [s for s in live
+               if seqs[s].deadline is not None and now >= seqs[s].deadline]
+    if expired:
+        fresh = [s for s in expired if not seqs[s].expired_reported]
+        for s in fresh:
+            seqs[s].expired_reported = True
+        telemetry.on_deadline(fresh)
+        raise DeadlineExceeded(
+            f"seq_ids {expired} exceeded their wall-clock deadline; "
+            "release() them (or re-queue with a fresh budget) and step "
+            "again", seq_ids=expired)
+    if seq_len is None:
+        return
+    over = [s for s in live if seqs[s].position + 1 > seq_len]
+    if over:
+        raise CapacityError(
+            f"decode step for seq_ids {over} would write KV past the "
+            f"compiled seq_len {seq_len}; release them or rebuild with a "
+            "larger seq_len", seq_ids=over)
 
 
 def _pad_paged_rows(pad_to, ids, pos, slots, bt, last):
@@ -155,12 +273,15 @@ class ContinuousBatchingAdapter:
     def __init__(self, app, telemetry=None):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
-            raise ValueError("app must be built with "
-                             "is_continuous_batching=True")
+            raise ConfigurationError("app must be built with "
+                                     "is_continuous_batching=True")
         self.app = app
         self.batch = cfg.batch_size
         self.seqs: Dict[int, _SeqState] = {}
         self.telemetry = _AdapterTelemetry("cb", telemetry)
+        # rolling caches (slot = pos % window) can decode past seq_len
+        self._pos_limit = (None if getattr(app.spec, "rolling_window", False)
+                           else cfg.seq_len)
 
     # -- capacity ---------------------------------------------------------
     @property
@@ -170,22 +291,32 @@ class ContinuousBatchingAdapter:
 
     # -- lifecycle --------------------------------------------------------
     def add_requests(self, seq_ids: Sequence[int],
-                     prompts: Sequence[Sequence[int]]) -> Dict[int, int]:
+                     prompts: Sequence[Sequence[int]],
+                     deadline_s: Union[None, float,
+                                       Sequence[Optional[float]]] = None
+                     ) -> Dict[int, int]:
         """Prefill ``prompts`` into cache rows ``seq_ids``. Returns
         {seq_id: first generated token}. Rows are padded to the ctx bucket
-        (repeat-row-0 batch pad — reference ``vllm_cte_repadding``)."""
-        if len(seq_ids) != len(prompts):
-            raise ValueError("seq_ids and prompts length mismatch")
+        (repeat-row-0 batch pad — reference ``vllm_cte_repadding``).
+        Transactional: a failure admits nothing (cache rows hold garbage
+        only for never-admitted seq_ids, which no live row can read)."""
+        _validate_admission(seq_ids, prompts, self.app.tpu_config.seq_len)
         for sid in seq_ids:
             if not 0 <= sid < self.batch:
-                raise ValueError(f"seq_id {sid} out of range [0,{self.batch})")
+                raise AdmissionError(f"seq_id {sid} out of range "
+                                     f"[0,{self.batch})")
             if sid in self.seqs:
-                raise ValueError(f"seq_id {sid} already running")
+                raise AdmissionError(f"seq_id {sid} already running")
         t0 = time.perf_counter()
+        deadlines = _resolve_deadlines(deadline_s, len(seq_ids), t0)
         b = len(seq_ids)
         lens = np.asarray([len(p) for p in prompts], np.int32)
-        width = autobucketing.get_target_bucket(self.app.ctx_buckets,
-                                                int(lens.max()), kind="ctx")
+        try:
+            width = autobucketing.get_target_bucket(
+                self.app.ctx_buckets, int(lens.max()), kind="ctx")
+        except ValueError as e:
+            raise AdmissionError(f"prompt does not fit any context-encoding "
+                                 f"bucket: {e}") from e
         ids = np.zeros((b, width), np.int32)
         for i, p in enumerate(prompts):
             ids[i, :len(p)] = p
@@ -193,22 +324,47 @@ class ContinuousBatchingAdapter:
         ids_p, sid_p = self._pad_rows(ids, np.asarray(seq_ids, np.int32),
                                       pad_to)
         lens_p = np.concatenate([lens, np.repeat(lens[:1], pad_to - b)])
-        out = self.app._run_prefill(ids_p, lens_p, seq_ids=sid_p)
-        toks = np.asarray(out["tokens"])[:b]
+        cache_before = self.app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("prefill_step")
+            out = self.app._run_prefill(ids_p, lens_p, seq_ids=sid_p)
+            # materialize INSIDE the try: dispatch is asynchronous, so a
+            # genuine device failure only surfaces when the tokens are
+            # fetched — it must still be wrapped and rolled back here
+            toks = np.asarray(out["tokens"])[:b]
+        except ServingError:
+            raise
+        except Exception as e:
+            self.telemetry.on_step_failure("prefill")
+            raise StepFailure(
+                "prefill device step failed; no sequences were admitted",
+                phase="prefill", seq_ids=seq_ids,
+                retry_safe=self.app.cache is cache_before) from e
         res = {}
         for i, sid in enumerate(seq_ids):
-            self.seqs[sid] = _SeqState(position=int(lens[i]),
-                                       last_token=int(toks[i]))
+            # no tokens/admit_idx bookkeeping here: the CB adapter has no
+            # preemption path (rows are fixed slots), so the recompute
+            # record the paged adapter keeps would be dead state
+            self.seqs[sid] = _SeqState(
+                position=int(lens[i]), last_token=int(toks[i]),
+                prompt_len=int(lens[i]), deadline=deadlines[i])
             res[sid] = int(toks[i])
         self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
         """One decode step for ``seq_ids`` (default: every running row).
-        Returns {seq_id: next token}."""
+        Returns {seq_id: next token}. Raises :class:`DeadlineExceeded` /
+        :class:`CapacityError` before any device work when a row is over
+        budget, and :class:`StepFailure` (state untouched, retryable) when
+        the device call itself fails."""
         live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
+        if _FAULTS.active:
+            _FAULTS.fire("slow_step")
+        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
         t0 = time.perf_counter()
         b = len(live)
         pad_to = self._batch_bucket(b)
@@ -218,9 +374,21 @@ class ContinuousBatchingAdapter:
         sid_p = np.concatenate([sid, np.repeat(sid[:1], pad_to - b)])
         toks_p = np.concatenate([toks, np.repeat(toks[:1], pad_to - b)])
         pos_p = np.concatenate([pos, np.repeat(pos[:1], pad_to - b)])
-        out = self.app._run_decode(toks_p[:, None], pos_p[:, None],
-                                   seq_ids=sid_p)
-        new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        cache_before = self.app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("decode_step")
+            out = self.app._run_decode(toks_p[:, None], pos_p[:, None],
+                                       seq_ids=sid_p)
+            new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        except ServingError:
+            raise
+        except Exception as e:
+            self.telemetry.on_step_failure("decode")
+            raise StepFailure(
+                "decode device step failed; positions were not advanced",
+                phase="decode", seq_ids=tuple(live),
+                retry_safe=self.app.cache is cache_before) from e
         res = {}
         for i, s in enumerate(live):
             st = self.seqs[s]
@@ -238,8 +406,8 @@ class ContinuousBatchingAdapter:
     # -- helpers ----------------------------------------------------------
     def _batch_bucket(self, b: int) -> int:
         if b > self.batch:
-            raise ValueError(f"live batch {b} exceeds compiled batch "
-                             f"{self.batch}")
+            raise CapacityError(f"live batch {b} exceeds compiled batch "
+                                f"{self.batch}")
         return autobucketing.get_target_bucket(self.app.batch_buckets, b,
                                                kind="batch")
 
@@ -256,78 +424,152 @@ class PagedEngineAdapter:
     """vLLM-style engine adapter over the PAGED app: block tables keyed by
     seq_id, slot mappings computed from the tables (reference: the
     slot_mapping / active_block_table contract of
-    block_kv_cache_manager.py + model_wrapper.py:1297-1313)."""
+    block_kv_cache_manager.py + model_wrapper.py:1297-1313).
 
-    def __init__(self, app, telemetry=None):
+    ``preemption_policy`` ("lifo" | "fewest_generated" | None) arms
+    recompute preemption: when the block pool cannot satisfy an allocation
+    the lowest-priority running sequence is evicted, its blocks reclaimed,
+    and a :class:`Preempted` record queued for :meth:`take_preempted` —
+    the engine re-queues ``record.tokens`` as a fresh prompt. ``None``
+    disables eviction (allocation failures then raise
+    :class:`CapacityError` after rolling the call back)."""
+
+    def __init__(self, app, telemetry=None,
+                 preemption_policy: Optional[str] = "lifo"):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
-            raise ValueError("app must be built with is_block_kv_layout=True")
+            raise ConfigurationError("app must be built with "
+                                     "is_block_kv_layout=True")
+        if (preemption_policy is not None
+                and preemption_policy not in PREEMPTION_POLICIES):
+            raise ConfigurationError(
+                f"unknown preemption_policy {preemption_policy!r}; expected "
+                f"one of {PREEMPTION_POLICIES} or None")
         self.app = app
         self.batch = cfg.batch_size
         self.seqs: Dict[int, _SeqState] = {}
         self.telemetry = _AdapterTelemetry("paged", telemetry)
+        self.preemption_policy = preemption_policy
+        self.preempted: List[Preempted] = []
+        self._admit_counter = 0
+        self._pos_limit = (None if getattr(app.spec, "rolling_window", False)
+                           else cfg.seq_len)
 
     def add_requests(self, seq_ids: Sequence[int],
-                     prompts: Sequence[Sequence[int]]) -> Dict[int, int]:
+                     prompts: Sequence[Sequence[int]],
+                     deadline_s: Union[None, float,
+                                       Sequence[Optional[float]]] = None
+                     ) -> Dict[int, int]:
+        """Transactional admission: either every sequence is admitted, or
+        every ``begin_sequence`` allocation from this call is rolled back
+        and cache state is exactly as before (pool pressure may still
+        preempt RUNNING sequences first — that eviction is reported via
+        :meth:`take_preempted` and survives a subsequent rollback, since
+        the preempted work is handed back to the engine either way)."""
         from .modules.block_kv_cache import slots_from_table
-        if len(seq_ids) != len(prompts):
-            raise ValueError("seq_ids and prompts length mismatch")
-        if len(set(seq_ids)) != len(seq_ids):
-            raise ValueError("duplicate seq_ids in one add_requests call")
+        _validate_admission(seq_ids, prompts, self.app.tpu_config.seq_len)
         for sid in seq_ids:
             if sid in self.seqs:
-                raise ValueError(f"seq_id {sid} already running")
+                raise AdmissionError(f"seq_id {sid} already running")
         t0 = time.perf_counter()
+        deadlines = _resolve_deadlines(deadline_s, len(seq_ids), t0)
         app = self.app
         b = len(seq_ids)
         lens = np.asarray([len(p) for p in prompts], np.int32)
         cached = np.zeros((b,), np.int32)
-        for i, sid in enumerate(seq_ids):
-            _, c = app.kv_mgr.begin_sequence(sid, list(prompts[i]))
-            cached[i] = min(c, lens[i] - 1)
-        width = autobucketing.get_target_bucket(
-            app.ctx_buckets, int((lens - cached).max()), kind="ctx")
-        bt = app.kv_mgr.block_table_array(seq_ids, app._bt_width_for(seq_ids))
-        ids_w = np.zeros((b, width), np.int32)
-        pos_w = np.zeros((b, width), np.int32)
-        for i, p in enumerate(prompts):
-            lo = int(cached[i])
-            n = int(lens[i] - lo)
-            ids_w[i, :n] = np.asarray(p[lo:lo + n])
-            pos_w[i] = lo + np.arange(width, dtype=np.int32)
-        valid = np.arange(width)[None, :] < (lens - cached)[:, None]
-        slots = slots_from_table(bt, np.where(valid, pos_w, -1),
-                                 app.kv_mgr.spec.block_size)
-        # repad to the compiled batch bucket (repeat row 0 - pad rows
-        # rewrite row 0's slots with identical values); without this every
-        # distinct live count would jit a fresh graph mid-serving
-        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
-                                                 kind="batch")
-        ids_w, pos_w, slots, bt2, last = _pad_paged_rows(
-            pad_to, ids_w, pos_w, slots, bt,
-            np.maximum(lens - cached - 1, 0))
-        out = app._run_paged(ids_w, pos_w, slots, bt2, last)
-        toks = np.asarray(out["tokens"]).reshape(-1)
+        begun: List[int] = []
+        cache_before = app.cache
+        try:
+            for i, sid in enumerate(seq_ids):
+                while True:
+                    try:
+                        _, c = app.kv_mgr.begin_sequence(sid,
+                                                         list(prompts[i]))
+                        begun.append(sid)
+                        break
+                    except CapacityError:
+                        victim = self._choose_victim()
+                        if victim is None:
+                            raise
+                        self._preempt(victim, reason="admission")
+                cached[i] = min(c, lens[i] - 1)
+            try:
+                width = autobucketing.get_target_bucket(
+                    app.ctx_buckets, int((lens - cached).max()), kind="ctx")
+            except ValueError as e:
+                raise AdmissionError(
+                    f"prompt does not fit any context-encoding bucket: "
+                    f"{e}") from e
+            bt = app.kv_mgr.block_table_array(seq_ids,
+                                              app._bt_width_for(seq_ids))
+            ids_w = np.zeros((b, width), np.int32)
+            pos_w = np.zeros((b, width), np.int32)
+            for i, p in enumerate(prompts):
+                lo = int(cached[i])
+                n = int(lens[i] - lo)
+                ids_w[i, :n] = np.asarray(p[lo:lo + n])
+                pos_w[i] = lo + np.arange(width, dtype=np.int32)
+            valid = np.arange(width)[None, :] < (lens - cached)[:, None]
+            slots = slots_from_table(bt, np.where(valid, pos_w, -1),
+                                     app.kv_mgr.spec.block_size)
+            # repad to the compiled batch bucket (repeat row 0 - pad rows
+            # rewrite row 0's slots with identical values); without this
+            # every distinct live count would jit a fresh graph mid-serving
+            pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
+                                                     kind="batch")
+            ids_w, pos_w, slots, bt2, last = _pad_paged_rows(
+                pad_to, ids_w, pos_w, slots, bt,
+                np.maximum(lens - cached - 1, 0))
+            if _FAULTS.active:
+                _FAULTS.fire("prefill_step")
+            out = app._run_paged(ids_w, pos_w, slots, bt2, last)
+            # materialize INSIDE the try: dispatch is asynchronous, so a
+            # genuine device failure only surfaces when the tokens are
+            # fetched — it must still be wrapped and rolled back here
+            toks = np.asarray(out["tokens"]).reshape(-1)
+        except ServingError:
+            self._rollback_admission(begun)
+            raise
+        except Exception as e:
+            self._rollback_admission(begun)
+            self.telemetry.on_step_failure("prefill")
+            raise StepFailure(
+                "paged prefill failed; all allocations from this call were "
+                "rolled back", phase="prefill", seq_ids=seq_ids,
+                retry_safe=app.cache is cache_before) from e
         res = {}
         for i, sid in enumerate(seq_ids):
-            self.seqs[sid] = _SeqState(position=int(lens[i]),
-                                       last_token=int(toks[i]))
+            self._admit_counter += 1
+            self.seqs[sid] = _SeqState(
+                position=int(lens[i]), last_token=int(toks[i]),
+                tokens=list(prompts[i]) + [int(toks[i])],
+                prompt_len=int(lens[i]), admit_idx=self._admit_counter,
+                deadline=deadlines[i])
             res[sid] = int(toks[i])
         self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
     def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
+        """One decode step for ``seq_ids`` (default: every running row).
+        Returns {seq_id: next token}. Under block-pool pressure, running
+        sequences may be preempted to make room (absent from the result;
+        collect them with :meth:`take_preempted`). A device failure rolls
+        host KV growth back and raises :class:`StepFailure` (retryable)."""
         from .modules.block_kv_cache import slots_from_table
         app = self.app
         live = _live_rows(self.seqs, seq_ids)
         if not live:
             return {}
+        if _FAULTS.active:
+            _FAULTS.fire("slow_step")
+        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
         t0 = time.perf_counter()
+        live = self._grow_with_preemption(live)
+        if not live:
+            return {}
         b = len(live)
         toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
         pos = np.asarray([self.seqs[s].position for s in live], np.int32)
-        for s in live:
-            app.kv_mgr.grow(s, 1)
         bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
         slots = slots_from_table(bt, pos[:, None],
                                  app.kv_mgr.spec.block_size)
@@ -336,13 +578,29 @@ class PagedEngineAdapter:
         ids_p, pos_p, slots_p, bt_p, last_p = _pad_paged_rows(
             pad_to, toks[:, None], pos[:, None], slots, bt,
             np.zeros((b,), np.int32))
-        out = app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
-        new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        cache_before = app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("decode_step")
+            out = app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
+            new = np.asarray(out["tokens"]).reshape(-1)[:b]
+        except ServingError:
+            self._rollback_grow(live)
+            raise
+        except Exception as e:
+            self._rollback_grow(live)
+            self.telemetry.on_step_failure("decode")
+            raise StepFailure(
+                "paged decode step failed; KV growth was rolled back and "
+                "positions were not advanced",
+                phase="decode", seq_ids=tuple(live),
+                retry_safe=app.cache is cache_before) from e
         res = {}
         for i, s in enumerate(live):
             st = self.seqs[s]
             st.position += 1
             st.last_token = int(new[i])
+            st.tokens.append(int(new[i]))
             res[s] = int(new[i])
         self.telemetry.on_step(live, t0, padded=pad_to)
         return res
@@ -354,3 +612,79 @@ class PagedEngineAdapter:
                 if sid in self.app.kv_mgr.tables:
                     self.app.kv_mgr.end_sequence(sid)
         self.telemetry.on_release(seq_ids)
+
+    # -- preemption -------------------------------------------------------
+    def take_preempted(self) -> List[Preempted]:
+        """Drain :class:`Preempted` records accumulated since the last
+        call. The engine re-queues each ``record.tokens`` as a new prompt;
+        under greedy sampling the recomputed continuation is bit-identical
+        to the uninterrupted run."""
+        out, self.preempted = self.preempted, []
+        return out
+
+    def _choose_victim(self) -> Optional[int]:
+        if self.preemption_policy is None:
+            return None
+        cands = [(sid, st.admit_idx, len(st.tokens) - st.prompt_len)
+                 for sid, st in self.seqs.items() if st.running]
+        return pick_victim(self.preemption_policy, cands)
+
+    def _preempt(self, victim: int, reason: str):
+        st = self.seqs.pop(victim)
+        if victim in self.app.kv_mgr.tables:
+            self.app.kv_mgr.end_sequence(victim)
+        self.preempted.append(Preempted(
+            seq_id=victim, tokens=tuple(st.tokens),
+            prompt_len=st.prompt_len,
+            n_generated=len(st.tokens) - st.prompt_len, reason=reason))
+        self.telemetry.on_preempt(victim, reason)
+
+    def _grow_with_preemption(self, live: Sequence[int]) -> List[int]:
+        """Grow every live row's block list by one token, evicting
+        victims per the policy when the pool is dry. Returns the rows
+        still live (preempted ones removed). If eviction cannot free
+        enough, all growth from this call is rolled back and the
+        :class:`CapacityError` propagates."""
+        app = self.app
+        live = list(live)
+        queue = list(live)
+        grown: List[int] = []
+        while queue:
+            s = queue[0]
+            try:
+                app.kv_mgr.grow(s, 1)
+            except CapacityError:
+                victim = self._choose_victim()
+                if victim is None:
+                    for g in grown:
+                        app.kv_mgr.shrink(g, 1)
+                    raise
+                self._preempt(victim, reason="grow")
+                for lst in (queue, live, grown):
+                    if victim in lst:
+                        lst.remove(victim)
+                continue
+            queue.pop(0)
+            grown.append(s)
+        return live
+
+    def _rollback_grow(self, live: Sequence[int]):
+        for s in live:
+            self.app.kv_mgr.shrink(s, 1)
+
+    def _rollback_admission(self, begun: Sequence[int]):
+        """Abort every sequence begun by the failing add_requests call:
+        frees its blocks and purges never-written content hashes from the
+        prefix cache (the free count is restored exactly; prefix-HIT
+        blocks whose content predates the call stay resident).
+
+        Reverse admission order matters: when prompts within the call
+        share a prefix, later sequences prefix-HIT blocks the first one
+        allocated (and hashed) moments earlier — unwinding in reverse
+        makes the ORIGINATING sequence's abort the last dereference, so
+        its invalidate (not a later sibling's plain free) retires the
+        never-written hash."""
+        for sid in reversed(begun):
+            if sid in self.app.kv_mgr.tables:
+                self.app.kv_mgr.abort_sequence(sid)
+        self.telemetry.on_admission_rollback()

@@ -20,6 +20,12 @@ LIVE_ROWS_TOTAL = "nxdi_live_rows_total"              # phase=prefill|decode
 PAD_ROWS_TOTAL = "nxdi_pad_rows_total"                # phase=prefill|decode
 REQUESTS_TOTAL = "nxdi_requests_total"                # event=added|released
 
+# -- serving resilience (serving.py + resilience/) --------------------------
+PREEMPTIONS_TOTAL = "nxdi_preemptions_total"            # engine, reason
+ADMISSION_ROLLBACKS_TOTAL = "nxdi_admission_rollbacks_total"   # engine
+DEADLINE_EXPIRED_TOTAL = "nxdi_deadline_expired_total"  # engine
+STEP_FAILURES_TOTAL = "nxdi_step_failures_total"        # engine, phase
+
 # -- application hot paths (models/application.py) --------------------------
 # kind: prefill|decode|decode_loop|paged ; part: host|device
 RUN_SECONDS = "nxdi_run_seconds"
@@ -85,6 +91,37 @@ def pad_rows_counter(reg):
 def requests_counter(reg):
     return reg.counter(REQUESTS_TOTAL, "Engine request lifecycle events",
                        labels=("engine", "event"))
+
+
+def preemptions_counter(reg):
+    return reg.counter(
+        PREEMPTIONS_TOTAL,
+        "Sequences evicted under KV block pressure (recompute preemption); "
+        "reason=grow|admission",
+        labels=("engine", "reason"))
+
+
+def admission_rollbacks_counter(reg):
+    return reg.counter(
+        ADMISSION_ROLLBACKS_TOTAL,
+        "add_requests calls that failed and were rolled back atomically",
+        labels=("engine",))
+
+
+def deadline_expired_counter(reg):
+    return reg.counter(
+        DEADLINE_EXPIRED_TOTAL,
+        "Requests that blew their per-request wall-clock deadline "
+        "(counted once per request)",
+        labels=("engine",))
+
+
+def step_failures_counter(reg):
+    return reg.counter(
+        STEP_FAILURES_TOTAL,
+        "Device steps that raised and were rolled back (StepFailure); "
+        "phase=prefill|decode",
+        labels=("engine", "phase"))
 
 
 def run_seconds_histogram(reg):

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Tier-1 lint: the serving surface raises ONLY the typed taxonomy.
+
+Fails (rc 1) when any checked file contains ``raise ValueError(...)`` or
+``raise RuntimeError(...)`` — those must be one of the
+``resilience.errors`` types instead (``AdmissionError``,
+``CapacityError``, ``DeadlineExceeded``, ``StepFailure``, ...), so an
+engine can branch on exception type to pick a recovery path. Bare
+re-raises (``raise`` with no expression) and every other exception class
+are allowed.
+
+Usage::
+
+    python scripts/check_error_paths.py            # lint the default set
+    python scripts/check_error_paths.py FILE...    # lint specific files
+
+Wired into the test suite as a tier-1 test
+(``tests/test_resilience.py::test_error_path_lint``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Sequence, Tuple
+
+BANNED = ("ValueError", "RuntimeError")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = (
+    "neuronx_distributed_inference_tpu/serving.py",
+    "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
+)
+
+
+def banned_raises(source: str) -> List[Tuple[int, str]]:
+    """(lineno, exception name) for every ``raise`` of a banned builtin."""
+    bad: List[Tuple[int, str]] = []
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, ast.Raise) or node.exc is None:
+            continue
+        target = node.exc
+        if isinstance(target, ast.Call):
+            target = target.func
+        if isinstance(target, ast.Name) and target.id in BANNED:
+            bad.append((node.lineno, target.id))
+    return bad
+
+
+def main(argv: Sequence[str] = ()) -> int:
+    paths = [Path(p) for p in argv] if argv else \
+        [REPO_ROOT / p for p in DEFAULT_PATHS]
+    rc = 0
+    for path in paths:
+        if not path.exists():
+            print(f"check_error_paths: {path}: missing", file=sys.stderr)
+            rc = 1
+            continue
+        for lineno, name in banned_raises(path.read_text()):
+            print(f"{path}:{lineno}: raise {name}(...) — use the typed "
+                  "taxonomy in neuronx_distributed_inference_tpu/"
+                  "resilience/errors.py", file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        print(f"check_error_paths: OK ({len(paths)} file(s) clean)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

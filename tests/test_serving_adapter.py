@@ -67,13 +67,19 @@ def test_continuous_batching_adapter_interleaved():
 
 
 def test_continuous_adapter_rejects_misuse():
+    from neuronx_distributed_inference_tpu.resilience import (
+        ConfigurationError, ServingError)
     tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
                      enable_bucketing=False)
     app = CausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
                               LlamaFamily)
     app.init_random_weights(7).init_cache()
-    with pytest.raises(ValueError):
+    # typed taxonomy at the boundary, still catchable as plain ValueError
+    # (pre-taxonomy compat — see README "Serving resilience")
+    with pytest.raises(ValueError) as ei:
         ContinuousBatchingAdapter(app)     # needs continuous batching
+    assert isinstance(ei.value, ConfigurationError)
+    assert isinstance(ei.value, ServingError)
 
 
 def test_paged_engine_adapter_interleaved():
