@@ -24,7 +24,118 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def host_overhead_main():
+    """CPU-runnable host-overhead microbench (ISSUE 3): drives the CB
+    serving adapter's decode paths on a tiny synthetic model and reports
+    host-ms/token, dispatches/token and host-blocking syncs/token for
+    eager step(), pipelined step() (pipeline_depth=1) and step_many(8) —
+    one parseable JSON line, no TPU required. The syncs/dispatches numbers
+    are structural (counted at the adapter boundary), so they hold on any
+    backend; the ms numbers are measured on whatever device runs."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        CausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import \
+        ContinuousBatchingAdapter
+
+    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, head_dim=16, vocab_size=512,
+              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
+              tie_word_embeddings=False, torch_dtype="float32")
+    batch, n_steps, chunk = 2, 48, 8
+    tcfg = TpuConfig(batch_size=batch, seq_len=128, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_continuous_batching=True)
+    app = CausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                              LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 500, size=8).tolist() for _ in range(batch)]
+    sids = list(range(batch))
+
+    def run(mode):
+        eng = ContinuousBatchingAdapter(
+            app, pipeline_depth=1 if mode == "pipelined" else 0)
+        eng.add_requests(sids, prompts)
+        base = dict(eng.host_stats)
+        t0 = time.perf_counter()
+        if mode == "step_many8":
+            for _ in range(n_steps // chunk):
+                eng.step_many(chunk)
+        else:
+            for _ in range(n_steps):
+                eng.step()
+            if mode == "pipelined":
+                eng.flush()
+        wall = time.perf_counter() - t0
+        stats = {k: eng.host_stats[k] - base[k] for k in base}
+        eng.release(sids)
+        toks = n_steps * batch
+        # host_blocked = host wall spent stalled inside blocking fetches —
+        # the host-overhead number proper. wall additionally includes the
+        # device compute itself (which on a CPU-only box shares the cores,
+        # so overlap cannot shorten it the way it does on a real TPU).
+        return {
+            "host_blocked_ms_per_token": round(
+                stats["blocked_s"] * 1e3 / toks, 4),
+            "wall_ms_per_token": round(wall * 1e3 / toks, 4),
+            "dispatches_per_token": round(stats["dispatches"] / toks, 4),
+            "blocking_syncs_per_token": round(
+                stats["blocking_fetches"] / toks, 4),
+        }
+
+    modes = ("eager", "pipelined", "step_many8")
+    for m in modes:
+        run(m)                         # warm: compile every graph
+    results = {m: run(m) for m in modes}
+    ratio = (results["eager"]["blocking_syncs_per_token"]
+             / results["step_many8"]["blocking_syncs_per_token"])
+    print(json.dumps({
+        "metric": "host_overhead_syncs_stepmany8_vs_eager",
+        "value": round(ratio, 2),
+        "unit": "x_fewer_host_blocking_syncs",
+        "details": {
+            **{m: results[m] for m in modes},
+            "decode_steps_per_mode": n_steps,
+            "batch": batch,
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }))
+
+
+def _host_overhead_fallback(error: str):
+    """No TPU: the throughput bench cannot run, but the CPU host-overhead
+    microbench CAN — emit its numbers so BENCH_* still tracks something
+    real (falls back to the plain skip line if even that fails)."""
+    try:
+        host_overhead_main()
+        print(json.dumps({
+            "skipped": "no TPU backend (decode throughput); CPU "
+                       "host-overhead microbench above",
+            "metric": "decode_throughput_llama1b_bf16_bs2",
+            "error": error,
+        }), file=sys.stderr)
+    except Exception as e:  # pragma: no cover - defensive
+        print(json.dumps({
+            "skipped": "no TPU backend",
+            "metric": "decode_throughput_llama1b_bf16_bs2",
+            "error": error,
+            "host_overhead_error": str(e)[:200],
+        }))
+
+
 def main():
+    if "--host-overhead" in sys.argv[1:]:
+        return host_overhead_main()
     from neuronx_distributed_inference_tpu.config import (InferenceConfig,
                                                           TpuConfig)
     from neuronx_distributed_inference_tpu.models.application import \
@@ -44,20 +155,12 @@ def main():
     try:
         devices = jax.devices()
     except RuntimeError as e:
-        print(json.dumps({
-            "skipped": "no TPU backend",
-            "metric": "decode_throughput_llama1b_bf16_bs2",
-            "error": str(e).splitlines()[0][:200],
-        }))
+        _host_overhead_fallback(str(e).splitlines()[0][:200])
         return
     if (devices[0].platform == "cpu"
             and os.environ.get("NXDI_BENCH_ALLOW_CPU") != "1"):
-        print(json.dumps({
-            "skipped": "no TPU backend",
-            "metric": "decode_throughput_llama1b_bf16_bs2",
-            "error": "only CPU devices available "
-                     "(NXDI_BENCH_ALLOW_CPU=1 to bench on CPU)",
-        }))
+        _host_overhead_fallback("only CPU devices available "
+                                "(NXDI_BENCH_ALLOW_CPU=1 to bench on CPU)")
         return
 
     reg = telemetry.enable()

@@ -558,6 +558,13 @@ class CausalLMApplication:
         b = first_tokens.shape[0]
         if seq_ids is None:
             seq_ids = np.arange(b, dtype=np.int32)
+        elif (not self.tpu_config.is_continuous_batching
+              and not np.array_equal(np.asarray(seq_ids), np.arange(b))):
+            # same boundary guard as _run_decode: without continuous
+            # batching the scanned decode graph skips the cache row-gather,
+            # so non-identity seq_ids would silently read the wrong rows
+            raise ValueError("non-identity seq_ids require "
+                             "is_continuous_batching=True")
         t0 = self._tel_start()
         needed = int(np.max(np.asarray(positions))) + num_steps
         self._check_decode_fits(needed)
@@ -1043,6 +1050,11 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_paged_loop(self, first_tokens, positions, block_table,
                         num_steps: int, sampling_params=None):
+        # horizon guard: the fused loop writes KV at positions
+        # [p, p+num_steps); past seq_len the in-graph slot advance would
+        # index past the block table (mirrors _run_decode_loop's guard)
+        self._check_decode_fits(
+            int(np.max(np.asarray(positions))) + num_steps)
         t0 = self._tel_start()
         key = ("paged_loop", num_steps)
         if key not in self._compiled:

@@ -142,6 +142,18 @@ def slots_from_table(block_table: np.ndarray, positions: np.ndarray,
     return np.where(positions < 0, -1, slots).astype(np.int32)
 
 
+def slots_from_table_into(out: np.ndarray, block_table: np.ndarray,
+                          positions: np.ndarray, block_size: int) -> None:
+    """In-place :func:`slots_from_table` for the serving adapters' per-step
+    scratch buffers: same slot values, no fresh (B, T) allocations on the
+    decode hot path (positions here are always real — the negative-drop
+    branch of the allocating variant is not needed)."""
+    np.floor_divide(positions, block_size, out=out)
+    out[:] = np.take_along_axis(block_table, out, axis=1)
+    out *= block_size
+    out += positions % block_size
+
+
 # ---------------------------------------------------------------------------
 # Block allocator + prefix cache (host)
 # ---------------------------------------------------------------------------
@@ -483,6 +495,24 @@ class BlockKVCacheManager:
             blks = self.tables.get(sid, [])[:max_blocks]
             out[i, :len(blks)] = blks
         return out
+
+    def fill_block_table(self, out: np.ndarray, seq_ids: Sequence[int],
+                         counts: List[int]) -> None:
+        """Incrementally refresh a cached block-table array IN PLACE:
+        rewrite only rows whose block list length differs from the
+        ``counts`` snapshot (updated in place too). Valid while tables
+        only grow append-only between calls — every serving path that
+        shrinks or rebuilds a table (step rollback, preemption,
+        end/begin_sequence) drops its scratch and rebuilds from
+        :meth:`block_table_array`. Entries past a row's block count are
+        left as-is: readers mask them out by position, so their values
+        never reach a live attention weight or cache write."""
+        for i, sid in enumerate(seq_ids):
+            blks = self.tables.get(sid, ())
+            n = min(len(blks), out.shape[1])
+            if n != counts[i]:
+                out[i, :n] = blks[:n]
+                counts[i] = n
 
     @property
     def max_blocks_per_seq(self) -> int:

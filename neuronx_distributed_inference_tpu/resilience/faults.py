@@ -24,6 +24,11 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      AFTER host-side KV growth, so it proves rollback
   ``slow_step``      start of ``step()`` — sleeps ``delay_s`` instead of
                      raising (drives deadline expiry deterministically)
+  ``pipeline_flush`` the deferred token fetch of the pipelined decode path
+                     (``pipeline_depth >= 1``) — fires where a genuine
+                     asynchronous device failure from the PREVIOUS dispatch
+                     would surface, so lookahead rollback is testable
+                     deterministically
 
 Hot-path cost while nothing is armed: a single attribute check
 (``FAULTS.active``) — no call, no allocation (pinned by
@@ -39,7 +44,8 @@ from .errors import CapacityError
 
 __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 
-FAULT_POINTS = ("paged_alloc", "prefill_step", "decode_step", "slow_step")
+FAULT_POINTS = ("paged_alloc", "prefill_step", "decode_step", "slow_step",
+                "pipeline_flush")
 
 
 class InjectedFault(RuntimeError):

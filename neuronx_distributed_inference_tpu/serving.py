@@ -10,12 +10,42 @@ The engine owns scheduling; this adapter owns device state:
     lines (cache rows are addressed BY seq_id, so request order is free)
   * ``step(seq_ids=None)``              — one decode step for the given
     (default: all) running rows, repadded to the compiled batch bucket
+  * ``step_many(k, seq_ids=None)``      — k fused decode steps in ONE
+    device dispatch + ONE host fetch (CB: the jitted lax.scan decode loop;
+    paged: the fused paged loop with in-graph KV-slot advance)
+  * ``flush()``                         — retire the pipelined in-flight
+    dispatch (no-op in eager mode)
   * ``release(seq_ids)``                — free rows (and paged blocks)
 
 Works over either application:
   - ``CausalLMApplication`` with ``is_continuous_batching=True`` —
     contiguous cache rows keyed by seq_id;
   - ``PagedCausalLMApplication`` — block tables keyed by seq_id.
+
+Decode pipeline (see README "Decode pipeline"):
+
+  * ``pipeline_depth=0`` (default) is the eager path, bit-identical to the
+    pre-pipeline behavior: every ``step()`` dispatches and synchronously
+    fetches its own tokens.
+  * ``pipeline_depth=1`` keeps the previous dispatch's sampled tokens ON
+    DEVICE and feeds them straight into the next decode call, fetching to
+    host asynchronously one step behind — host bookkeeping overlaps device
+    compute and the device never idles behind Python between steps.
+    ``step()`` then returns the PREVIOUS step's tokens ({} on the first
+    call); ``flush()`` drains the last one. Token streams are bit-identical
+    to eager (pinned by tests/test_decode_pipeline.py).
+  * Deferred-failure contract: a device failure from step N surfaces at
+    step N+1's fetch as a :class:`StepFailure` with ``retry_safe=False``;
+    every in-flight lookahead step's host bookkeeping (positions, paged KV
+    growth) is rolled back to the last DELIVERED token. The
+    ``pipeline_flush`` fault point makes this deterministic in tests.
+  * Hot-path host bookkeeping is incremental: per-(live set, batch bucket)
+    scratch buffers are filled in place instead of rebuilt via
+    np.concatenate/np.repeat each step, and the paged block-table array is
+    refreshed only for rows whose block list actually grew.
+  * The dispatch helpers (``_dispatch_*``) must never materialize device
+    values — enforced by the tier-1 AST lint
+    ``scripts/check_host_sync.py``.
 
 Resilience contract (see README "Serving resilience"):
 
@@ -30,18 +60,22 @@ Resilience contract (see README "Serving resilience"):
     "fewest_generated" / None), handing back :class:`Preempted` records
     via :meth:`PagedEngineAdapter.take_preempted`;
   * per-request wall-clock deadlines (``deadline_s``) and a
-    decode-past-``seq_len`` guard bound each request's budget.
+    decode-past-``seq_len`` guard bound each request's budget; both are
+    horizon-aware (``step_many(k)`` checks them once for the whole k-step
+    horizon, before any device work).
 """
 
 from __future__ import annotations
 
+import bisect
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
 from .modules import autobucketing
+from .modules.block_kv_cache import slots_from_table_into
 from .resilience.errors import (AdmissionError, CapacityError,
                                 ConfigurationError, DeadlineExceeded,
                                 SequenceStateError, ServingError, StepFailure)
@@ -64,12 +98,38 @@ class _SeqState:
     expired_reported: bool = False     # deadline metric counted once
 
 
+@dataclass
+class _Inflight:
+    """One dispatched-but-not-fetched decode step (pipeline_depth >= 1).
+
+    ``states`` pins the exact _SeqState objects the dispatch advanced:
+    retire/rollback apply only where the identity still matches, so a row
+    released (or preempted) and re-admitted under the same seq_id while
+    the step was in flight can never receive the stale token."""
+    live: Tuple[int, ...]
+    states: Tuple[_SeqState, ...]
+    b: int
+    pad_to: int
+    out: Dict[str, Any]
+    t_dispatch: float
+    grown: int = 0                # paged KV tokens grown for this dispatch
+
+
+def _async_fetch(x):
+    """Start the device->host copy without blocking (no-op for array types
+    without the API, e.g. plain numpy under test fakes)."""
+    try:
+        x.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
 class _AdapterTelemetry:
     """Shared engine-adapter instrumentation: TTFT / per-step decode latency
-    histograms, live-batch + pad-waste accounting, one request span per
-    seq_id. Host-side only (measures at the adapter boundary — the device
-    fetch has already happened when these run); every method is a cheap
-    no-op while telemetry is disabled."""
+    histograms, live-batch + pad-waste accounting, pipeline depth/overlap/
+    steps-per-fetch, one request span per seq_id. Host-side only (measures
+    at the adapter boundary); every method is a cheap no-op while telemetry
+    is disabled."""
 
     def __init__(self, engine: str, telemetry=None):
         self.engine = engine
@@ -100,21 +160,40 @@ class _AdapterTelemetry:
         tmetrics.generated_tokens_counter(reg).inc(live, engine=self.engine)
         self._rows(reg, "prefill", live, padded)
 
-    def on_step(self, live_ids: Sequence[int], t0: float, padded: int):
+    def on_step(self, live_ids: Sequence[int], t0: float, padded: int,
+                steps: int = 1):
         reg = self.registry
         if not reg.enabled:
             return
         now = time.perf_counter()
-        tmetrics.decode_step_histogram(reg).observe(now - t0,
+        n = len(live_ids)
+        # per-STEP latency even for a fused k-step horizon, so the
+        # histogram stays comparable across step()/step_many() modes
+        tmetrics.decode_step_histogram(reg).observe((now - t0) / steps,
                                                     engine=self.engine)
-        tmetrics.generated_tokens_counter(reg).inc(len(live_ids),
+        tmetrics.generated_tokens_counter(reg).inc(n * steps,
                                                    engine=self.engine)
         for sid in live_ids:
             info = self._requests.get(sid)
             if info is not None:
-                info["steps"] += 1
+                info["steps"] += steps
                 info["t_last"] = now
-        self._rows(reg, "decode", len(live_ids), padded)
+        self._rows(reg, "decode", n, padded, steps=steps)
+
+    def on_dispatch(self, depth: int):
+        reg = self.registry
+        if reg.enabled:
+            tmetrics.dispatch_depth_gauge(reg).set(depth, engine=self.engine)
+
+    def on_fetch(self, steps: int, overlap_s: Optional[float] = None):
+        reg = self.registry
+        if not reg.enabled:
+            return
+        tmetrics.steps_per_fetch_histogram(reg).observe(steps,
+                                                        engine=self.engine)
+        if overlap_s is not None:
+            tmetrics.host_overlap_histogram(reg).observe(overlap_s,
+                                                         engine=self.engine)
 
     def on_release(self, seq_ids: Sequence[int]):
         # pop unconditionally: requests admitted while telemetry was live
@@ -169,12 +248,13 @@ class _AdapterTelemetry:
         if reg.enabled:
             tmetrics.admission_rollbacks_counter(reg).inc(engine=self.engine)
 
-    def _rows(self, reg, phase: str, live: int, padded: int):
+    def _rows(self, reg, phase: str, live: int, padded: int,
+              steps: int = 1):
         tmetrics.live_batch_gauge(reg).set(live, engine=self.engine)
-        tmetrics.live_rows_counter(reg).inc(live, engine=self.engine,
+        tmetrics.live_rows_counter(reg).inc(live * steps, engine=self.engine,
                                             phase=phase)
         if padded > live:
-            tmetrics.pad_rows_counter(reg).inc(padded - live,
+            tmetrics.pad_rows_counter(reg).inc((padded - live) * steps,
                                                engine=self.engine,
                                                phase=phase)
 
@@ -225,12 +305,15 @@ def _resolve_deadlines(deadline_s, n: int,
 
 
 def _pre_step_checks(seqs: Dict[int, _SeqState], live: Sequence[int],
-                     seq_len: Optional[int], telemetry: _AdapterTelemetry):
+                     seq_len: Optional[int], telemetry: _AdapterTelemetry,
+                     horizon: int = 1):
     """Per-request budget enforcement, BEFORE any device work or cache
     growth: wall-clock deadlines, then the decode-past-seq_len guard (a
     row at position seq_len-1 holds its last representable token — one
-    more step would scatter KV out of bounds). ``seq_len`` is None for
-    rolling-window caches (slot = pos % window never overflows)."""
+    more step would scatter KV out of bounds). ``horizon`` is the number
+    of fused steps about to run (``step_many``); the guard covers the
+    whole horizon. ``seq_len`` is None for rolling-window caches
+    (slot = pos % window never overflows)."""
     now = time.perf_counter()
     expired = [s for s in live
                if seqs[s].deadline is not None and now >= seqs[s].deadline]
@@ -245,32 +328,467 @@ def _pre_step_checks(seqs: Dict[int, _SeqState], live: Sequence[int],
             "again", seq_ids=expired)
     if seq_len is None:
         return
-    over = [s for s in live if seqs[s].position + 1 > seq_len]
+    over = [s for s in live if seqs[s].position + horizon > seq_len]
     if over:
         raise CapacityError(
-            f"decode step for seq_ids {over} would write KV past the "
-            f"compiled seq_len {seq_len}; release them or rebuild with a "
-            "larger seq_len", seq_ids=over)
+            f"decode step (horizon {horizon}) for seq_ids {over} would "
+            f"write KV past the compiled seq_len {seq_len}; release them "
+            "or rebuild with a larger seq_len", seq_ids=over)
+
+
+def _repeat_row0(x: np.ndarray, pad_to: int) -> np.ndarray:
+    """Pad a batch axis to ``pad_to`` by repeating row 0 — THE batch-pad
+    invariant (pad rows recompute row 0's data and rewrite its cache
+    slots with identical values; reference: vllm_cte_repadding,
+    model_wrapper.py:1297-1313)."""
+    return np.concatenate([x, np.repeat(x[:1], pad_to - x.shape[0],
+                                        axis=0)])
 
 
 def _pad_paged_rows(pad_to, ids, pos, slots, bt, last):
-    """Repeat row 0 up to the batch bucket; pad rows harmlessly rewrite
-    row 0's slots with identical values (reference: vllm_cte_repadding,
-    model_wrapper.py:1297-1313)."""
+    """Repeat row 0 up to the batch bucket (see :func:`_repeat_row0`)."""
     b = ids.shape[0]
     if b == pad_to:
         return ids, pos, slots, bt, last
-
-    def rep(x):
-        return np.concatenate([x, np.repeat(x[:1], pad_to - b, axis=0)])
-    return rep(ids), rep(pos), rep(slots), rep(bt), rep(last)
+    return tuple(_repeat_row0(x, pad_to) for x in (ids, pos, slots, bt,
+                                                   last))
 
 
-class ContinuousBatchingAdapter:
+# ---------------------------------------------------------------------------
+# Per-composition scratch buffers (incremental host bookkeeping)
+# ---------------------------------------------------------------------------
+
+class _CbScratch:
+    """Reusable decode-step input buffers for one (live set, batch bucket)
+    composition on the contiguous adapter: the per-step np.concatenate /
+    np.repeat rebuilds become in-place fills.
+
+    The mutable input buffers are DOUBLE-BUFFERED (ping-pong): jax's CPU
+    backend may alias a suitably-aligned numpy array zero-copy, so
+    refilling the buffer a still-in-flight pipelined dispatch aliases
+    would corrupt its input mid-execution. Each fill() flips buffers; a
+    set is only rewritten after its dispatch was retired (depth <= 1)."""
+
+    def __init__(self, live: Sequence[int], pad_to: int):
+        b = len(live)
+        self.live = tuple(live)
+        self.b = b
+        self.pad_to = pad_to
+        self.sid_p = np.empty((pad_to,), np.int32)   # immutable after init
+        self.sid_p[:b] = live
+        self.sid_p[b:] = live[0]
+        self._bufs = [(np.empty((pad_to, 1), np.int32),
+                       np.empty((pad_to, 1), np.int32)) for _ in range(2)]
+        self._cur = 0
+        self.toks_p, self.pos_p = self._bufs[0]
+        # device-feedback re-pad map: pad rows must stay clones of row 0
+        self.gather_idx = np.concatenate(
+            [np.arange(b, dtype=np.intp),
+             np.zeros(pad_to - b, dtype=np.intp)])
+
+    def fill(self, adapter, need_tokens: bool = True):
+        self._cur ^= 1
+        self.toks_p, self.pos_p = self._bufs[self._cur]
+        seqs = adapter.seqs
+        for i, s in enumerate(self.live):
+            st = seqs[s]
+            self.pos_p[i, 0] = st.position
+            if need_tokens:
+                self.toks_p[i, 0] = st.last_token
+        if self.pad_to > self.b:
+            self.pos_p[self.b:] = self.pos_p[0, 0]
+            if need_tokens:
+                self.toks_p[self.b:] = self.toks_p[0, 0]
+
+
+class _PagedScratch:
+    """Reusable decode-step input buffers for one (live set, batch bucket,
+    table-width bucket) composition on the paged adapter. The block-table
+    array is refreshed incrementally (only rows whose block list grew);
+    slot mappings are recomputed in place from the cached table.
+
+    Double-buffered like :class:`_CbScratch` (jax CPU zero-copy aliasing):
+    each fill() flips to the other (ids, pos, slots, bt, counts) set, so
+    the buffers a still-in-flight dispatch aliases are never rewritten."""
+
+    def __init__(self, live: Sequence[int], pad_to: int, width: int,
+                 block_size: int):
+        b = len(live)
+        self.live = tuple(live)
+        self.b = b
+        self.pad_to = pad_to
+        self.width = width
+        self.last = np.zeros((pad_to,), np.int32)    # immutable after init
+        self._bufs = [(np.empty((pad_to, 1), np.int32),
+                       np.empty((pad_to, 1), np.int32),
+                       np.empty((pad_to, 1), np.int32),
+                       np.zeros((pad_to, width), np.int32),
+                       [0] * b) for _ in range(2)]
+        self._cur = 0
+        self.ids, self.pos, self.slots, self.bt, self.counts = self._bufs[0]
+        self.gather_idx = np.concatenate(
+            [np.arange(b, dtype=np.intp),
+             np.zeros(pad_to - b, dtype=np.intp)])
+        self._block_size = block_size
+
+    def fill(self, adapter, need_tokens: bool = True):
+        self._cur ^= 1
+        (self.ids, self.pos, self.slots, self.bt,
+         self.counts) = self._bufs[self._cur]
+        seqs = adapter.seqs
+        mgr = adapter.app.kv_mgr
+        for i, s in enumerate(self.live):
+            st = seqs[s]
+            self.pos[i, 0] = st.position
+            if need_tokens:
+                self.ids[i, 0] = st.last_token
+        prev0 = self.counts[0]
+        mgr.fill_block_table(self.bt[:self.b], self.live, self.counts)
+        if self.pad_to > self.b:
+            self.pos[self.b:] = self.pos[0, 0]
+            if need_tokens:
+                self.ids[self.b:] = self.ids[0, 0]
+            if self.counts[0] != prev0:
+                self.bt[self.b:] = self.bt[0]
+        slots_from_table_into(self.slots, self.bt, self.pos,
+                              self._block_size)
+
+
+# ---------------------------------------------------------------------------
+# Shared adapter machinery (pipeline + fused multi-step + eager template)
+# ---------------------------------------------------------------------------
+
+class _EngineAdapterBase:
+    """Decode-path machinery shared by both adapters: the eager step
+    template, the depth-1 decode pipeline (device-resident token feedback,
+    deferred fetch, lookahead-aware rollback) and ``step_many``. Subclasses
+    provide dispatch, scratch construction, KV growth and token
+    bookkeeping."""
+
+    engine_name = ""
+    _decode_failure_msg = "decode device step failed"
+
+    def _init_decode_path(self, pipeline_depth: int):
+        if pipeline_depth not in (0, 1):
+            raise ConfigurationError(
+                f"pipeline_depth must be 0 (eager) or 1 (one dispatch of "
+                f"lookahead), got {pipeline_depth!r}")
+        self.pipeline_depth = pipeline_depth
+        self._inflight: Optional[_Inflight] = None
+        self._ready: Dict[int, int] = {}
+        self._scratch = None
+        # plain-int host counters (always on — they feed the CPU
+        # host-overhead microbench, bench.py --host-overhead)
+        self.host_stats: Dict[str, Any] = {
+            "dispatches": 0, "device_steps": 0,
+            "blocking_fetches": 0, "blocked_s": 0.0}
+
+    # -- subclass hooks ----------------------------------------------------
+    def _grow_for_step(self, live: List[int], n: int = 1) -> List[int]:
+        return live
+
+    def _rollback_step_growth(self, live: Sequence[int], n: int = 1):
+        pass
+
+    def _append_token(self, st: _SeqState, tok: int):
+        st.last_token = tok
+
+    _step_growth = 0              # paged: KV tokens grown per dispatch
+
+    # -- fetch helpers (the ONLY places that block on device output) -------
+    def _fetch_rows(self, out, b: int) -> np.ndarray:
+        t0 = time.perf_counter()
+        toks = np.asarray(out["tokens"])
+        self.host_stats["blocking_fetches"] += 1
+        self.host_stats["blocked_s"] += time.perf_counter() - t0
+        return toks.reshape(toks.shape[0], -1)[:b]
+
+    # -- public decode surface ---------------------------------------------
+    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
+        """One decode step for ``seq_ids`` (default: every running row).
+
+        Eager (``pipeline_depth=0``): returns {seq_id: next token} for THIS
+        step. Pipelined (``pipeline_depth=1``): dispatches this step and
+        returns the PREVIOUS step's tokens ({} on the first call after the
+        pipeline empties; drain the last step with :meth:`flush`). Raises
+        :class:`DeadlineExceeded` / :class:`CapacityError` before any
+        device work when a row is over budget, and :class:`StepFailure`
+        when a device step fails — see the class docstring for the
+        deferred-failure rollback contract."""
+        if self.pipeline_depth:
+            return self._step_pipelined(seq_ids)
+        return self._step_eager(seq_ids)
+
+    def step_many(self, num_steps: int,
+                  seq_ids: Optional[Sequence[int]] = None
+                  ) -> Dict[int, List[int]]:
+        """``num_steps`` fused decode steps in ONE device dispatch and ONE
+        blocking host fetch. Returns {seq_id: [tokens]} in stream order;
+        a pipelined adapter's in-flight token is drained first and
+        prepended (it is simply the preceding token of the same stream).
+        Deadlines and the seq_len guard are enforced once for the whole
+        horizon, before any device work. EOS handling stays with the
+        engine, at horizon boundaries."""
+        if num_steps < 1:
+            raise ConfigurationError("step_many requires num_steps >= 1")
+        if self._inflight is not None or self._ready:
+            self._stash_flush()
+        # pending drained tokens stay in self._ready until this call is
+        # past every fallible stage — a recoverable DeadlineExceeded /
+        # CapacityError / StepFailure must not drop them from the stream
+        live = _live_rows(self.seqs, seq_ids)
+        if not live:
+            return {s: [t] for s, t in self._drain_ready().items()}
+        if _FAULTS.active:
+            _FAULTS.fire("slow_step")
+        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry,
+                         horizon=num_steps)
+        t0 = time.perf_counter()
+        live = self._grow_for_step(live, num_steps)
+        if not live:
+            return {s: [t] for s, t in self._drain_ready().items()}
+        toks, pad_to = self._run_many(live, num_steps)
+        res = {s: [t] for s, t in self._drain_ready().items()}
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            st.position += num_steps
+            row = [int(t) for t in toks[i]]
+            for t in row:
+                self._append_token(st, t)
+            res.setdefault(s, []).extend(row)
+        self.telemetry.on_step(live, t0, padded=pad_to, steps=num_steps)
+        self.telemetry.on_fetch(num_steps)
+        return res
+
+    def flush(self) -> Dict[int, int]:
+        """Retire the in-flight pipelined dispatch (if any) and hand back
+        every token not yet delivered: {seq_id: token}. {} in eager mode.
+        A deferred fetch failure aborts the pipeline (StepFailure,
+        ``retry_safe=False``)."""
+        ready = self._drain_ready()
+        rec, self._inflight = self._inflight, None
+        if rec is not None:
+            try:
+                ready.update(self._retire_or_abort([rec]))
+            except BaseException:
+                # the drained tokens were already generated and applied to
+                # host state — keep them deliverable past the failure
+                self._ready = {**ready, **self._ready}
+                raise
+        return ready
+
+    # -- eager path --------------------------------------------------------
+    def _step_eager(self, seq_ids) -> Dict[int, int]:
+        live = _live_rows(self.seqs, seq_ids)
+        if not live:
+            return {}
+        if _FAULTS.active:
+            _FAULTS.fire("slow_step")
+        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
+        t0 = time.perf_counter()
+        live = self._grow_for_step(live)
+        if not live:
+            return {}
+        scr = self._scratch_for(live)
+        scr.fill(self)
+        cache_before = self.app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("decode_step")
+            out = self._dispatch_decode(scr)
+            new = self._fetch_rows(out, len(live))
+        except ServingError:
+            self._rollback_step_growth(live)
+            self._scratch = None
+            raise
+        except Exception as e:
+            self._rollback_step_growth(live)
+            self._scratch = None
+            self.telemetry.on_step_failure("decode")
+            raise StepFailure(
+                self._decode_failure_msg + "; positions were not advanced",
+                phase="decode", seq_ids=tuple(live),
+                retry_safe=self.app.cache is cache_before) from e
+        res = {}
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            st.position += 1
+            tok = int(new[i, 0])
+            self._append_token(st, tok)
+            res[s] = tok
+        self.telemetry.on_step(live, t0, padded=scr.pad_to)
+        self.telemetry.on_fetch(1)
+        return res
+
+    # -- pipelined path ----------------------------------------------------
+    def _step_pipelined(self, seq_ids) -> Dict[int, int]:
+        live = _live_rows(self.seqs, seq_ids)
+        if not live:
+            return self.flush()
+        if _FAULTS.active:
+            _FAULTS.fire("slow_step")
+        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
+        ready = self._drain_ready()
+        try:
+            return self._advance_pipeline(live, ready)
+        except BaseException:
+            # tokens drained (or retired) this call were already generated
+            # and applied to host state — keep them deliverable past a
+            # recoverable failure instead of dropping them from the stream
+            self._ready = {**ready, **self._ready}
+            raise
+
+    def _advance_pipeline(self, live: List[int],
+                          ready: Dict[int, int]) -> Dict[int, int]:
+        prev, self._inflight = self._inflight, None
+        if prev is not None and not self._matches(prev, live):
+            # live-set changed since the dispatch: drain it synchronously
+            ready.update(self._retire_or_abort([prev]))
+            prev = None
+        t0 = time.perf_counter()
+        try:
+            live = self._grow_for_step(live)
+        except ServingError:
+            self._inflight = prev          # growth rolled itself back
+            raise
+        if not live:
+            self._inflight = prev
+            return ready
+        if prev is not None and not self._matches(prev, live):
+            # preemption shrank the batch mid-call: drain the old
+            # composition's dispatch before re-padding for the new one
+            ready.update(self._retire_or_abort([prev]))
+            prev = None
+        scr = self._scratch_for(live)
+        scr.fill(self, need_tokens=prev is None)
+        toks_dev = None if prev is None else self._feedback_tokens(prev, scr)
+        cache_before = self.app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("decode_step")
+            out = self._dispatch_decode(scr, toks_dev)
+        except ServingError:
+            self._rollback_step_growth(live)
+            self._scratch = None
+            self._inflight = prev          # lookahead step is still healthy
+            raise
+        except Exception as e:
+            self._rollback_step_growth(live)
+            self._scratch = None
+            self._inflight = prev
+            self.telemetry.on_step_failure("decode")
+            raise StepFailure(
+                self._decode_failure_msg + " at dispatch; the in-flight "
+                "lookahead step was preserved",
+                phase="decode", seq_ids=tuple(live),
+                retry_safe=self.app.cache is cache_before) from e
+        rec = _Inflight(
+            live=tuple(live),
+            states=tuple(self.seqs[s] for s in live),
+            b=len(live), pad_to=scr.pad_to, out=out, t_dispatch=t0,
+            grown=self._step_growth)
+        for s in live:
+            self.seqs[s].position += 1
+        if prev is not None:
+            ready.update(self._retire_or_abort([prev, rec]))
+        self._inflight = rec
+        self.telemetry.on_dispatch(1)
+        return ready
+
+    def _matches(self, rec: _Inflight, live: Sequence[int]) -> bool:
+        return (rec.live == tuple(live)
+                and all(self.seqs.get(s) is st
+                        for s, st in zip(rec.live, rec.states)))
+
+    def _feedback_tokens(self, prev: _Inflight, scr):
+        """The previous dispatch's on-device sampled tokens, re-padded ON
+        DEVICE (pad rows must stay clones of row 0 even under stochastic
+        sampling) and fed straight back as the next step's input ids — no
+        host round trip."""
+        toks = prev.out["tokens"].reshape(-1)
+        if scr.pad_to > scr.b:
+            toks = toks[scr.gather_idx]
+        return toks[:, None]
+
+    def _retire(self, rec: _Inflight) -> Dict[int, int]:
+        """Materialize ``rec``'s tokens (the ONE blocking sync of the
+        pipelined path) and apply the deferred host bookkeeping. Raises
+        the raw fetch failure — callers route it through
+        :meth:`_abort_pipeline`."""
+        if _FAULTS.active:
+            _FAULTS.fire("pipeline_flush")
+        overlap = time.perf_counter() - rec.t_dispatch
+        new = self._fetch_rows(rec.out, rec.b)
+        res = {}
+        for i, (s, st) in enumerate(zip(rec.live, rec.states)):
+            if self.seqs.get(s) is not st:
+                continue               # released/preempted while in flight
+            tok = int(new[i, 0])
+            self._append_token(st, tok)
+            res[s] = tok
+        self.telemetry.on_step(list(res), rec.t_dispatch, padded=rec.pad_to)
+        self.telemetry.on_fetch(1, overlap_s=overlap)
+        self.telemetry.on_dispatch(0)
+        return res
+
+    def _retire_or_abort(self, records: List[Optional[_Inflight]]
+                         ) -> Dict[int, int]:
+        try:
+            return self._retire(records[0])
+        except Exception as e:
+            self._abort_pipeline(records, e)
+
+    def _abort_pipeline(self, records: Sequence[Optional[_Inflight]],
+                        cause: Exception):
+        """A deferred fetch failed: the in-flight step's device output (and
+        any dispatch speculatively issued on top of it) is garbage. Unwind
+        every in-flight dispatch's host bookkeeping — positions and paged
+        KV growth return to the last DELIVERED token — and raise a
+        :class:`StepFailure` with ``retry_safe=False`` (the donated device
+        cache was consumed by the failed dispatch chain; re-admit or
+        rebuild)."""
+        self._scratch = None
+        seq_ids: Tuple[int, ...] = ()
+        for rec in records:
+            if rec is None:
+                continue
+            if not seq_ids:
+                seq_ids = rec.live
+            for s, st in zip(rec.live, rec.states):
+                if self.seqs.get(s) is st:
+                    st.position -= 1
+            self._unwind_inflight_growth(rec)
+        self.telemetry.on_dispatch(0)
+        self.telemetry.on_step_failure("decode")
+        raise StepFailure(
+            "pipelined decode fetch failed; every in-flight lookahead step "
+            "was rolled back to the last delivered token",
+            phase="decode", seq_ids=seq_ids, retry_safe=False) from cause
+
+    def _unwind_inflight_growth(self, rec: _Inflight):
+        pass
+
+    def _drain_ready(self) -> Dict[int, int]:
+        if not self._ready:
+            return {}
+        out, self._ready = self._ready, {}
+        return out
+
+    def _stash_flush(self):
+        """flush() into the pending buffer, so tokens drained by
+        add/release/step_many are handed back by the next returning call
+        instead of being dropped."""
+        for s, t in self.flush().items():
+            self._ready[s] = t
+
+
+class ContinuousBatchingAdapter(_EngineAdapterBase):
     """vLLM-style engine adapter over the contiguous app
     (reference: model_wrapper.py:1297-1440)."""
 
-    def __init__(self, app, telemetry=None):
+    engine_name = "cb"
+
+    def __init__(self, app, telemetry=None, pipeline_depth: int = 0):
         cfg = app.tpu_config
         if not cfg.is_continuous_batching:
             raise ConfigurationError("app must be built with "
@@ -282,12 +800,14 @@ class ContinuousBatchingAdapter:
         # rolling caches (slot = pos % window) can decode past seq_len
         self._pos_limit = (None if getattr(app.spec, "rolling_window", False)
                            else cfg.seq_len)
+        # free rows, ascending — maintained incrementally on add/release
+        self._free: List[int] = list(range(self.batch))
+        self._init_decode_path(pipeline_depth)
 
     # -- capacity ---------------------------------------------------------
     @property
     def free_slots(self) -> List[int]:
-        used = set(self.seqs)
-        return [i for i in range(self.batch) if i not in used]
+        return list(self._free)
 
     # -- lifecycle --------------------------------------------------------
     def add_requests(self, seq_ids: Sequence[int],
@@ -299,7 +819,9 @@ class ContinuousBatchingAdapter:
         {seq_id: first generated token}. Rows are padded to the ctx bucket
         (repeat-row-0 batch pad — reference ``vllm_cte_repadding``).
         Transactional: a failure admits nothing (cache rows hold garbage
-        only for never-admitted seq_ids, which no live row can read)."""
+        only for never-admitted seq_ids, which no live row can read). A
+        pipelined in-flight decode step stays in flight — the next step()
+        drains it when the live set changes."""
         _validate_admission(seq_ids, prompts, self.app.tpu_config.seq_len)
         for sid in seq_ids:
             if not 0 <= sid < self.batch:
@@ -349,59 +871,75 @@ class ContinuousBatchingAdapter:
             self.seqs[sid] = _SeqState(
                 position=int(lens[i]), last_token=int(toks[i]),
                 prompt_len=int(lens[i]), deadline=deadlines[i])
+            del self._free[bisect.bisect_left(self._free, sid)]
             res[sid] = int(toks[i])
         self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
-    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
-        """One decode step for ``seq_ids`` (default: every running row).
-        Returns {seq_id: next token}. Raises :class:`DeadlineExceeded` /
-        :class:`CapacityError` before any device work when a row is over
-        budget, and :class:`StepFailure` (state untouched, retryable) when
-        the device call itself fails."""
-        live = _live_rows(self.seqs, seq_ids)
-        if not live:
-            return {}
-        if _FAULTS.active:
-            _FAULTS.fire("slow_step")
-        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
-        t0 = time.perf_counter()
+    def release(self, seq_ids: Sequence[int]):
+        if self._inflight is not None:
+            self._stash_flush()
+        for sid in seq_ids:
+            self._ready.pop(sid, None)
+            if self.seqs.pop(sid, None) is not None:
+                bisect.insort(self._free, sid)
+        self.telemetry.on_release(seq_ids)
+
+    # -- decode dispatch ---------------------------------------------------
+    def _scratch_for(self, live: Sequence[int]) -> _CbScratch:
+        pad_to = self._batch_bucket(len(live))
+        scr = self._scratch
+        if scr is None or scr.live != tuple(live) or scr.pad_to != pad_to:
+            scr = self._scratch = _CbScratch(live, pad_to)
+        return scr
+
+    def _dispatch_decode(self, scr: _CbScratch, toks_dev=None):
+        """Issue ONE decode step to the device without materializing any
+        output (region lint: scripts/check_host_sync.py) — the blocking
+        fetch happens in the caller (eager) or at retire time (pipelined).
+        ``toks_dev``: previous dispatch's on-device tokens (pipelined
+        feedback); None = host tokens from the scratch buffer."""
+        ids = scr.toks_p if toks_dev is None else toks_dev
+        out = self.app._run_decode(ids, scr.pos_p, seq_ids=scr.sid_p)
+        _async_fetch(out["tokens"])
+        self.host_stats["dispatches"] += 1
+        self.host_stats["device_steps"] += 1
+        return out
+
+    def _run_many(self, live: List[int], num_steps: int):
+        """Fused k-step decode through the jitted lax.scan loop
+        (model_base.decode_loop) — one dispatch, one fetch."""
         b = len(live)
         pad_to = self._batch_bucket(b)
-        sid = np.asarray(live, np.int32)
-        toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
-        pos = np.asarray([self.seqs[s].position for s in live], np.int32)
-        sid_p = np.concatenate([sid, np.repeat(sid[:1], pad_to - b)])
-        toks_p = np.concatenate([toks, np.repeat(toks[:1], pad_to - b)])
-        pos_p = np.concatenate([pos, np.repeat(pos[:1], pad_to - b)])
+        first = np.empty((pad_to,), np.int32)
+        pos = np.empty((pad_to,), np.int32)
+        sid = np.empty((pad_to,), np.int32)
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            first[i] = st.last_token
+            pos[i] = st.position
+            sid[i] = s
+        first[b:] = first[0]
+        pos[b:] = pos[0]
+        sid[b:] = sid[0]
         cache_before = self.app.cache
         try:
             if _FAULTS.active:
                 _FAULTS.fire("decode_step")
-            out = self.app._run_decode(toks_p[:, None], pos_p[:, None],
-                                       seq_ids=sid_p)
-            new = np.asarray(out["tokens"]).reshape(-1)[:b]
+            out = self.app._run_decode_loop(first, pos, num_steps,
+                                            seq_ids=sid)
+            self.host_stats["dispatches"] += 1
+            self.host_stats["device_steps"] += num_steps
+            toks = self._fetch_rows(out, b)
         except ServingError:
             raise
         except Exception as e:
             self.telemetry.on_step_failure("decode")
             raise StepFailure(
-                "decode device step failed; positions were not advanced",
+                "fused decode loop failed; positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
                 retry_safe=self.app.cache is cache_before) from e
-        res = {}
-        for i, s in enumerate(live):
-            st = self.seqs[s]
-            st.position += 1
-            st.last_token = int(new[i])
-            res[s] = int(new[i])
-        self.telemetry.on_step(live, t0, padded=pad_to)
-        return res
-
-    def release(self, seq_ids: Sequence[int]):
-        for sid in seq_ids:
-            self.seqs.pop(sid, None)
-        self.telemetry.on_release(seq_ids)
+        return toks, pad_to
 
     # -- helpers ----------------------------------------------------------
     def _batch_bucket(self, b: int) -> int:
@@ -420,7 +958,7 @@ class ContinuousBatchingAdapter:
                 np.concatenate([seq_ids, np.repeat(seq_ids[:1], pad)]))
 
 
-class PagedEngineAdapter:
+class PagedEngineAdapter(_EngineAdapterBase):
     """vLLM-style engine adapter over the PAGED app: block tables keyed by
     seq_id, slot mappings computed from the tables (reference: the
     slot_mapping / active_block_table contract of
@@ -434,8 +972,14 @@ class PagedEngineAdapter:
     disables eviction (allocation failures then raise
     :class:`CapacityError` after rolling the call back)."""
 
+    engine_name = "paged"
+    _decode_failure_msg = ("paged decode step failed; KV growth was rolled "
+                          "back")
+    _step_growth = 1
+
     def __init__(self, app, telemetry=None,
-                 preemption_policy: Optional[str] = "lifo"):
+                 preemption_policy: Optional[str] = "lifo",
+                 pipeline_depth: int = 0):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -454,6 +998,7 @@ class PagedEngineAdapter:
         self._admit_counter = 0
         self._pos_limit = (None if getattr(app.spec, "rolling_window", False)
                            else cfg.seq_len)
+        self._init_decode_path(pipeline_depth)
 
     def add_requests(self, seq_ids: Sequence[int],
                      prompts: Sequence[Sequence[int]],
@@ -538,6 +1083,10 @@ class PagedEngineAdapter:
                 "rolled back", phase="prefill", seq_ids=seq_ids,
                 retry_safe=app.cache is cache_before) from e
         res = {}
+        # fresh block tables: a cached scratch whose row coincidentally
+        # kept its block COUNT would otherwise keep serving the old block
+        # ids (fill_block_table's append-only contract)
+        self._scratch = None
         for i, sid in enumerate(seq_ids):
             self._admit_counter += 1
             self.seqs[sid] = _SeqState(
@@ -549,76 +1098,107 @@ class PagedEngineAdapter:
         self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
         return res
 
-    def step(self, seq_ids: Optional[Sequence[int]] = None) -> Dict[int, int]:
-        """One decode step for ``seq_ids`` (default: every running row).
-        Returns {seq_id: next token}. Under block-pool pressure, running
-        sequences may be preempted to make room (absent from the result;
-        collect them with :meth:`take_preempted`). A device failure rolls
-        host KV growth back and raises :class:`StepFailure` (retryable)."""
-        from .modules.block_kv_cache import slots_from_table
+    def release(self, seq_ids: Sequence[int]):
+        if self._inflight is not None:
+            self._stash_flush()
+        for sid in seq_ids:
+            self._ready.pop(sid, None)
+            if sid in self.seqs:
+                self.seqs.pop(sid)
+                self._scratch = None       # its blocks are gone; see add
+                if sid in self.app.kv_mgr.tables:
+                    self.app.kv_mgr.end_sequence(sid)
+        self.telemetry.on_release(seq_ids)
+
+    # -- decode dispatch ---------------------------------------------------
+    def _append_token(self, st: _SeqState, tok: int):
+        st.last_token = tok
+        st.tokens.append(tok)
+
+    def _grow_for_step(self, live: List[int], n: int = 1) -> List[int]:
+        return self._grow_with_preemption(live, n)
+
+    def _rollback_step_growth(self, live: Sequence[int], n: int = 1):
+        self._rollback_grow(live, n)
+
+    def _unwind_inflight_growth(self, rec: _Inflight):
+        if not rec.grown:
+            return
+        for s, st in zip(rec.live, rec.states):
+            if self.seqs.get(s) is st and s in self.app.kv_mgr.tables:
+                self.app.kv_mgr.shrink(s, rec.grown)
+
+    def _scratch_for(self, live: Sequence[int]) -> _PagedScratch:
         app = self.app
-        live = _live_rows(self.seqs, seq_ids)
-        if not live:
-            return {}
-        if _FAULTS.active:
-            _FAULTS.fire("slow_step")
-        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
-        t0 = time.perf_counter()
-        live = self._grow_with_preemption(live)
-        if not live:
-            return {}
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets,
+                                                 len(live), kind="batch")
+        width = app._bt_width_for(live)
+        scr = self._scratch
+        if (scr is None or scr.live != tuple(live) or scr.pad_to != pad_to
+                or scr.width != width):
+            scr = self._scratch = _PagedScratch(
+                live, pad_to, width, app.kv_mgr.spec.block_size)
+        return scr
+
+    def _dispatch_decode(self, scr: _PagedScratch, toks_dev=None):
+        """Issue ONE paged decode step to the device without materializing
+        any output (region lint: scripts/check_host_sync.py). ``toks_dev``:
+        previous dispatch's on-device tokens (pipelined feedback); None =
+        host tokens from the scratch buffer."""
+        ids = scr.ids if toks_dev is None else toks_dev
+        out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt, scr.last)
+        _async_fetch(out["tokens"])
+        self.host_stats["dispatches"] += 1
+        self.host_stats["device_steps"] += 1
+        return out
+
+    def _run_many(self, live: List[int], num_steps: int):
+        """Fused k-step paged decode (model_base.paged_decode_loop): blocks
+        for the whole horizon are pre-allocated, slot mappings advance
+        IN-GRAPH — one dispatch, one fetch, zero per-token host work."""
+        app = self.app
         b = len(live)
-        toks = np.asarray([self.seqs[s].last_token for s in live], np.int32)
-        pos = np.asarray([self.seqs[s].position for s in live], np.int32)
-        bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
-        slots = slots_from_table(bt, pos[:, None],
-                                 app.kv_mgr.spec.block_size)
         pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
                                                  kind="batch")
-        ids_p, pos_p, slots_p, bt_p, last_p = _pad_paged_rows(
-            pad_to, toks[:, None], pos[:, None], slots, bt,
-            np.zeros((b,), np.int32))
+        bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
+        first = np.empty((b,), np.int32)
+        pos = np.empty((b,), np.int32)
+        for i, s in enumerate(live):
+            st = self.seqs[s]
+            first[i] = st.last_token
+            pos[i] = st.position
+        if pad_to > b:
+            first = _repeat_row0(first, pad_to)
+            pos = _repeat_row0(pos, pad_to)
+            bt = _repeat_row0(bt, pad_to)
         cache_before = app.cache
         try:
             if _FAULTS.active:
                 _FAULTS.fire("decode_step")
-            out = app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
-            new = np.asarray(out["tokens"]).reshape(-1)[:b]
+            out = app._run_paged_loop(first, pos, bt, num_steps)
+            self.host_stats["dispatches"] += 1
+            self.host_stats["device_steps"] += num_steps
+            toks = self._fetch_rows(out, b)
         except ServingError:
-            self._rollback_grow(live)
+            self._rollback_grow(live, num_steps)
             raise
         except Exception as e:
-            self._rollback_grow(live)
+            self._rollback_grow(live, num_steps)
             self.telemetry.on_step_failure("decode")
             raise StepFailure(
-                "paged decode step failed; KV growth was rolled back and "
-                "positions were not advanced",
+                "fused paged decode loop failed; KV growth was rolled back "
+                "and positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
                 retry_safe=app.cache is cache_before) from e
-        res = {}
-        for i, s in enumerate(live):
-            st = self.seqs[s]
-            st.position += 1
-            st.last_token = int(new[i])
-            st.tokens.append(int(new[i]))
-            res[s] = int(new[i])
-        self.telemetry.on_step(live, t0, padded=pad_to)
-        return res
-
-    def release(self, seq_ids: Sequence[int]):
-        for sid in seq_ids:
-            if sid in self.seqs:
-                self.seqs.pop(sid)
-                if sid in self.app.kv_mgr.tables:
-                    self.app.kv_mgr.end_sequence(sid)
-        self.telemetry.on_release(seq_ids)
+        return toks, pad_to
 
     # -- preemption -------------------------------------------------------
     def take_preempted(self) -> List[Preempted]:
         """Drain :class:`Preempted` records accumulated since the last
         call. The engine re-queues each ``record.tokens`` as a new prompt;
         under greedy sampling the recomputed continuation is bit-identical
-        to the uninterrupted run."""
+        to the uninterrupted run (a token still in the pipeline when its
+        sequence is preempted is regenerated by the replay)."""
         out, self.preempted = self.preempted, []
         return out
 
@@ -631,6 +1211,7 @@ class PagedEngineAdapter:
 
     def _preempt(self, victim: int, reason: str):
         st = self.seqs.pop(victim)
+        self._scratch = None               # victim's blocks are reclaimed
         if victim in self.app.kv_mgr.tables:
             self.app.kv_mgr.end_sequence(victim)
         self.preempted.append(Preempted(
@@ -639,8 +1220,9 @@ class PagedEngineAdapter:
             n_generated=len(st.tokens) - st.prompt_len, reason=reason))
         self.telemetry.on_preempt(victim, reason)
 
-    def _grow_with_preemption(self, live: Sequence[int]) -> List[int]:
-        """Grow every live row's block list by one token, evicting
+    def _grow_with_preemption(self, live: Sequence[int],
+                              n: int = 1) -> List[int]:
+        """Grow every live row's block list by ``n`` tokens, evicting
         victims per the policy when the pool is dry. Returns the rows
         still live (preempted ones removed). If eviction cannot free
         enough, all growth from this call is rolled back and the
@@ -652,12 +1234,12 @@ class PagedEngineAdapter:
         while queue:
             s = queue[0]
             try:
-                app.kv_mgr.grow(s, 1)
+                app.kv_mgr.grow(s, n)
             except CapacityError:
                 victim = self._choose_victim()
                 if victim is None:
                     for g in grown:
-                        app.kv_mgr.shrink(g, 1)
+                        app.kv_mgr.shrink(g, n)
                     raise
                 self._preempt(victim, reason="grow")
                 for lst in (queue, live, grown):
@@ -668,9 +1250,9 @@ class PagedEngineAdapter:
             grown.append(s)
         return live
 
-    def _rollback_grow(self, live: Sequence[int]):
+    def _rollback_grow(self, live: Sequence[int], n: int = 1):
         for s in live:
-            self.app.kv_mgr.shrink(s, 1)
+            self.app.kv_mgr.shrink(s, n)
 
     def _rollback_admission(self, begun: Sequence[int]):
         """Abort every sequence begun by the failing add_requests call:

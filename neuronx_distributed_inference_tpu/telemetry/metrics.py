@@ -20,6 +20,11 @@ LIVE_ROWS_TOTAL = "nxdi_live_rows_total"              # phase=prefill|decode
 PAD_ROWS_TOTAL = "nxdi_pad_rows_total"                # phase=prefill|decode
 REQUESTS_TOTAL = "nxdi_requests_total"                # event=added|released
 
+# -- decode pipeline (serving.py) --------------------------------------------
+DISPATCH_DEPTH = "nxdi_dispatch_depth"                  # engine
+HOST_OVERLAP_SECONDS = "nxdi_host_overlap_seconds"      # engine
+STEPS_PER_FETCH = "nxdi_steps_per_fetch"                # engine
+
 # -- serving resilience (serving.py + resilience/) --------------------------
 PREEMPTIONS_TOTAL = "nxdi_preemptions_total"            # engine, reason
 ADMISSION_ROLLBACKS_TOTAL = "nxdi_admission_rollbacks_total"   # engine
@@ -91,6 +96,31 @@ def pad_rows_counter(reg):
 def requests_counter(reg):
     return reg.counter(REQUESTS_TOTAL, "Engine request lifecycle events",
                        labels=("engine", "event"))
+
+
+def dispatch_depth_gauge(reg):
+    return reg.gauge(
+        DISPATCH_DEPTH,
+        "Device decode dispatches in flight whose tokens have not been "
+        "fetched to the host yet (0 = eager; pipeline_depth bounds it)",
+        labels=("engine",))
+
+
+def host_overlap_histogram(reg):
+    return reg.histogram(
+        HOST_OVERLAP_SECONDS,
+        "Host wall time between a pipelined decode dispatch and its "
+        "deferred token fetch — bookkeeping overlapped with device "
+        "compute (s)",
+        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def steps_per_fetch_histogram(reg):
+    return reg.histogram(
+        STEPS_PER_FETCH,
+        "Device decode steps retired per blocking host fetch (1 = eager "
+        "step(), k = step_many(k); _count is the fetches, _sum the steps)",
+        labels=("engine",), buckets=(1, 2, 4, 8, 16, 32, 64))
 
 
 def preemptions_counter(reg):

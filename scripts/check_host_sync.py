@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Tier-1 lint: no host-blocking materialization in the dispatch region.
+
+The serving adapters' pipelined decode path relies on ``_dispatch_*``
+helpers issuing device work WITHOUT fetching any output — a blocking
+``np.asarray(out["tokens"])`` (or friends) inside the dispatch region
+would serialize host and device and silently destroy the pipeline's
+overlap. This lint fails (rc 1) when any function whose name starts with
+``_dispatch`` in the checked files contains a call spelled with one of
+the blocking/materializing attributes:
+
+    asarray  array  device_get  block_until_ready  item  tolist
+
+The list deliberately OVER-approximates: ``np.array`` over a host list
+would not block, but dispatch helpers take fully-prepared scratch inputs
+by contract, so any array construction inside the region is a smell and
+gets flagged too. The blocking fetch belongs in the retire/fetch helpers
+(``_retire`` / ``_fetch_rows``), which run one step behind the dispatch.
+
+Usage::
+
+    python scripts/check_host_sync.py            # lint the default set
+    python scripts/check_host_sync.py FILE...    # lint specific files
+
+Wired into the test suite as a tier-1 test
+(``tests/test_decode_pipeline.py::test_host_sync_lint``).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Sequence, Tuple
+
+BANNED_ATTRS = ("asarray", "array", "device_get", "block_until_ready",
+                "item", "tolist")
+REGION_PREFIX = "_dispatch"
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = (
+    "neuronx_distributed_inference_tpu/serving.py",
+)
+
+
+def blocking_calls(source: str) -> List[Tuple[int, str, str]]:
+    """(lineno, function, attr) for every banned call inside a dispatch
+    region function."""
+    bad: List[Tuple[int, str, str]] = []
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not node.name.startswith(REGION_PREFIX):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Attribute) and fn.attr in BANNED_ATTRS:
+                bad.append((sub.lineno, node.name, fn.attr))
+    return bad
+
+
+def main(argv: Sequence[str] = ()) -> int:
+    paths = [Path(p) for p in argv] if argv else \
+        [REPO_ROOT / p for p in DEFAULT_PATHS]
+    rc = 0
+    for path in paths:
+        if not path.exists():
+            print(f"check_host_sync: {path}: missing", file=sys.stderr)
+            rc = 1
+            continue
+        for lineno, func, attr in blocking_calls(path.read_text()):
+            print(f"{path}:{lineno}: .{attr}(...) inside dispatch-region "
+                  f"function {func!r} — device output must not be "
+                  "materialized before retire/fetch (decode pipeline "
+                  "contract)", file=sys.stderr)
+            rc = 1
+    if rc == 0:
+        print(f"check_host_sync: OK ({len(paths)} file(s) clean)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
