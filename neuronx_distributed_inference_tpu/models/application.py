@@ -1161,7 +1161,8 @@ class PagedCausalLMApplication(CausalLMApplication):
                  teacher_tokens: Optional[np.ndarray] = None) -> Dict[str, Any]:
         """Paged generation. Prefix-cached prompt blocks are skipped
         (not recomputed); the rest mirrors CausalLMApplication.generate."""
-        from ..modules.block_kv_cache import slots_from_table
+        from ..modules.block_kv_cache import (cut_cached_at_unwritten,
+                                              slots_from_table)
         if teacher_tokens is not None:
             raise NotImplementedError("teacher forcing uses the contiguous app")
         logits_trace: List[np.ndarray] = []
@@ -1202,12 +1203,9 @@ class PagedCausalLMApplication(CausalLMApplication):
                 # chunked prefill writes sibling rows' blocks chunk by chunk,
                 # so a prefix hit on a block allocated earlier in this SAME
                 # batch may read slots the sibling hasn't written yet — cut
-                # the cached prefix at the first such block (recomputing a
-                # shared block writes identical values, so this is safe)
-                for bi in range(c // bsz):
-                    if blocks[bi] in batch_fresh:
-                        c = bi * bsz
-                        break
+                # the cached prefix at the first such block (shared helper
+                # with the serving adapter's packed-chunk path)
+                c = cut_cached_at_unwritten(blocks, c, bsz, batch_fresh)
             batch_fresh.update(blocks[c // bsz:])
             # always recompute >= 1 token so there are logits to sample from
             cached[i] = min(c, seq_lens[i] - 1)

@@ -84,6 +84,20 @@ def batch_buckets(tpu_config) -> List[int]:
     return generate_buckets(1, full)
 
 
+def prefill_chunk_buckets(ctx_buckets: List[int],
+                          chunk_tokens: Optional[int] = None) -> List[int]:
+    """Width ladder for packed prefill-chunk dispatches (serving.py
+    ``PagedEngineAdapter``): the ctx buckets up to (and including) the
+    smallest bucket covering ``chunk_tokens`` — chunk dispatches then only
+    ever run at already-compiled ctx-bucket widths, never a fresh shape.
+    ``None`` keeps the full ladder (chunk = largest ctx bucket, the
+    monolithic-equivalent default)."""
+    if chunk_tokens is None:
+        return list(ctx_buckets)
+    cap = get_target_bucket(ctx_buckets, min(chunk_tokens, ctx_buckets[-1]))
+    return [b for b in ctx_buckets if b <= cap]
+
+
 def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:
     """Paged-app block-table width ladder (reference: 2-D prefix x prefill
     buckets, autobucketing.py:22-64 + selection model_wrapper.py:923-1045):

@@ -142,6 +142,21 @@ def slots_from_table(block_table: np.ndarray, positions: np.ndarray,
     return np.where(positions < 0, -1, slots).astype(np.int32)
 
 
+def cut_cached_at_unwritten(blocks: Sequence[int], cached_tokens: int,
+                            block_size: int, unwritten) -> int:
+    """Clamp a prefix-cache hit against blocks whose contents are not
+    fully written yet: a hit on a block freshly allocated by a sibling in
+    the same batch — or by a still-in-flight chunked prefill — may read
+    slots the writer's chunk has not landed. Cut the cached prefix at the
+    first such block and recompute from there (recomputing a shared block
+    writes identical values, so the cut is always safe). ``unwritten`` is
+    any container of block ids supporting ``in``."""
+    for bi in range(cached_tokens // block_size):
+        if blocks[bi] in unwritten:
+            return bi * block_size
+    return cached_tokens
+
+
 def slots_from_table_into(out: np.ndarray, block_table: np.ndarray,
                           positions: np.ndarray, block_size: int) -> None:
     """In-place :func:`slots_from_table` for the serving adapters' per-step
@@ -475,17 +490,30 @@ class BlockKVCacheManager:
         self._hit_blocks.pop(seq_id, None)
         self._tel_occupancy()
 
-    def abort_sequence(self, seq_id: int):
+    def abort_sequence(self, seq_id: int, unwritten=None):
         """End a sequence admitted by a transaction that failed before (or
         while) its prefill wrote KV: prefix-HIT blocks — whose content
         predates the aborted call — are freed normally, but fresh blocks
         are :meth:`~BlockAllocator.invalidate`\\ d so their never-written
-        contents can never be served as prefix hits."""
+        contents can never be served as prefix hits.
+
+        ``unwritten`` (chunked-prefill teardown) overrides the allocator's
+        hit/fresh split with an explicit container of block ids whose
+        content never fully landed: a prefix HIT on a block another
+        still-pending sequence allocated (and hashed) but has not written
+        yet is itself unwritten, and must be invalidated — not freed as
+        valid — or its garbage KV becomes servable once the last holder
+        lets go."""
         blocks = self.tables.pop(seq_id)
         n_hit = self._hit_blocks.pop(seq_id, 0)
         self.lens.pop(seq_id)
-        self.allocator.free(blocks[:n_hit])
-        self.allocator.invalidate(blocks[n_hit:])
+        if unwritten is None:
+            self.allocator.free(blocks[:n_hit])
+            self.allocator.invalidate(blocks[n_hit:])
+        else:
+            self.allocator.free([b for b in blocks if b not in unwritten])
+            self.allocator.invalidate(
+                [b for b in blocks if b in unwritten])
         self._tel_occupancy()
 
     def block_table_array(self, seq_ids: Sequence[int], max_blocks: int

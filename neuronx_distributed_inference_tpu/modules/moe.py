@@ -203,9 +203,17 @@ def _glu(moe: MoESpec, gate: jnp.ndarray, up: jnp.ndarray) -> jnp.ndarray:
 
 def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
                   top_idx: jnp.ndarray, wg: jnp.ndarray, wu: jnp.ndarray,
-                  wd: jnp.ndarray, bg=None, bu=None, bd=None) -> jnp.ndarray:
+                  wd: jnp.ndarray, bg=None, bu=None, bd=None,
+                  local_experts: bool = False) -> jnp.ndarray:
     """All-experts dense compute (reference: moe_token_gen all-experts decode
-    kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H); b* optional (E,·) biases."""
+    kernel). x (B,T,H); wg/wu (E,H,I); wd (E,I,H); b* optional (E,·) biases.
+
+    ``local_experts``: the weights were re-constrained all-experts-local
+    with the intermediate dim split tp-major over ("tp","ep")
+    (tkg_experts_local decode) — the intermediate activation must follow
+    the same layout, or GSPMD reshards the freshly gathered weights
+    straight back to expert-parallel (the involuntary-full-remat warning
+    MULTICHIP r05 flagged)."""
     dt = x.dtype
     combine = combine_matrix(moe.num_experts, top_vals, top_idx)  # (B,T,E)
     if moe.input_scaled:
@@ -222,7 +230,9 @@ def experts_dense(moe: MoESpec, x: jnp.ndarray, top_vals: jnp.ndarray,
     if bg is not None:
         gate = gate + bg
         up = up + bu
-    inter = shard_constraint(_glu(moe, gate, up), AXIS_DP, None, AXIS_EP, AXIS_TP)
+    inter_spec = ((AXIS_DP, None, None, (AXIS_TP, AXIS_EP)) if local_experts
+                  else (AXIS_DP, None, AXIS_EP, AXIS_TP))
+    inter = shard_constraint(_glu(moe, gate, up), *inter_spec)
     outs = qeinsum("btei,eih->bteh", inter, wd)
     if bd is not None:
         outs = outs + bd
@@ -292,17 +302,46 @@ def moe_block(moe: MoESpec, x: jnp.ndarray, layer_w: Dict[str, Any],
               else (None, None, None))
     wg, wu, wd = (layer_w["expert_gate"], layer_w["expert_up"],
                   layer_w["expert_down"])
-    if moe.tkg_experts_local and phase == "decode":
+    if (moe.tkg_experts_local and phase == "decode"
+            and experts is experts_dense and not is_quantized_leaf(wg)):
         # hybrid TKG sharding: all experts local, intermediate split over
-        # BOTH model axes (see MoESpec.tkg_experts_local)
-        def recon(w, ps):
-            if is_quantized_leaf(w):
-                return w     # scale shapes vary; keep the stored layout
-            return shard_constraint(w, *ps)
-        wg = recon(wg, (None, None, (AXIS_EP, AXIS_TP)))
-        wu = recon(wu, (None, None, (AXIS_EP, AXIS_TP)))
-        wd = recon(wd, (None, (AXIS_EP, AXIS_TP), None))
+        # BOTH model axes (see MoESpec.tkg_experts_local). DENSE path
+        # only: the ragged grouped-matmul fallthrough (decode batch above
+        # dense_max_tokens) has no matching intermediate constraint, so
+        # re-laid weights would just be resharded back per step — it keeps
+        # the stored expert-parallel layout instead.
+        #
+        # Two-step reshard, tp-MAJOR on the intermediate dim: the sliced
+        # layer weight can reach the decode layout by an ep all-gather of
+        # the expert dim plus a LOCAL slice of the intermediate shard each
+        # device already holds. The previous one-shot constraint (ep-major
+        # intermediate split, against a producer whose tp annotation the
+        # layer-scan slice had dropped) forced GSPMD into "involuntary
+        # full rematerialization" — replicate-then-repartition — on every
+        # decode step (MULTICHIP r05 spmd_partitioner warnings). The first
+        # constraint re-pins the STORED layout (pure annotation, no data
+        # motion); the second is then all-gather + slice.
+        def recon(w, stored, target):
+            return shard_constraint(shard_constraint(w, *stored), *target)
+        wg = recon(wg, (AXIS_EP, None, AXIS_TP),
+                   (None, None, (AXIS_TP, AXIS_EP)))
+        wu = recon(wu, (AXIS_EP, None, AXIS_TP),
+                   (None, None, (AXIS_TP, AXIS_EP)))
+        wd = recon(wd, (AXIS_EP, AXIS_TP, None),
+                   (None, (AXIS_TP, AXIS_EP), None))
+        # the dense compute must KEEP the local-expert layout for its
+        # intermediate, or GSPMD reshards the weights back (see
+        # experts_dense.local_experts)
+        y = experts_dense(moe, x, top_vals, top_idx, wg, wu, wd,
+                          *biases, local_experts=True)
+        return _shared_experts(moe, x, y, layer_w)
     y = experts(moe, x, top_vals, top_idx, wg, wu, wd, *biases)
+    return _shared_experts(moe, x, y, layer_w)
+
+
+def _shared_experts(moe: MoESpec, x: jnp.ndarray, y: jnp.ndarray,
+                    layer_w: Dict[str, Any]) -> jnp.ndarray:
+    """Add the always-on shared-expert branch (DeepSeek/GLM style)."""
     if moe.shared_intermediate > 0:
         act = _act_fn(moe.act)
         s = act(qlinear(x, layer_w["shared_gate"])) * qlinear(x, layer_w["shared_up"])

@@ -20,6 +20,10 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      :class:`~.errors.CapacityError`, indistinguishable
                      from a genuinely exhausted pool
   ``prefill_step``   the device prefill call inside ``add_requests``
+  ``prefill_chunk``  one packed chunk dispatch of the paged adapter's
+                     chunked prefill path — fires BEFORE the dispatch, so
+                     rollback of partially-prefilled sequences (progress
+                     made by earlier chunks) is exercised deterministically
   ``decode_step``    the device decode call inside ``step()`` — fires
                      AFTER host-side KV growth, so it proves rollback
   ``slow_step``      start of ``step()`` — sleeps ``delay_s`` instead of
@@ -44,8 +48,8 @@ from .errors import CapacityError
 
 __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 
-FAULT_POINTS = ("paged_alloc", "prefill_step", "decode_step", "slow_step",
-                "pipeline_flush")
+FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
+                "decode_step", "slow_step", "pipeline_flush")
 
 
 class InjectedFault(RuntimeError):

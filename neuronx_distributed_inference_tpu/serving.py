@@ -47,6 +47,37 @@ Decode pipeline (see README "Decode pipeline"):
     values — enforced by the tier-1 AST lint
     ``scripts/check_host_sync.py``.
 
+Chunked, packed, schedulable prefill — paged adapter only (see README
+"Chunked prefill"; reference analog: ragged/mixed-batch TPU prefill,
+"Ragged Paged Attention" arxiv 2604.15464):
+
+  * each admitted prompt's uncached suffix is split into
+    ``prefill_chunk_tokens``-sized chunks driven through the ``_run_paged``
+    slot-mapping path (positions are arbitrary), so prompts up to
+    ``seq_len`` are admissible regardless of the largest ctx bucket.
+    Intermediate chunk samples are discarded; only the final chunk's token
+    is delivered. Token streams are bit-identical to monolithic admission
+    (pinned by tests/test_chunked_prefill.py).
+  * chunks from DIFFERENT sequences pack as ragged rows of one ctx-bucket
+    dispatch (each row at its own offset over its own block table), so a
+    batch of skewed-length prompts no longer pads every row to the longest
+    suffix — reclaimed pad waste is reported via ``nxdi_prefill_pad_waste``
+    and ``nxdi_prefill_chunks_total``.
+  * ``prefill_budget_tokens`` defers prefill to the scheduler:
+    ``add_requests`` only admits (block allocation + chunk state) and
+    returns ``{}``; each ``step()``/``step_many()`` then runs AT MOST ONE
+    packed chunk dispatch of at most that many prompt tokens before its
+    decode work, so a long admission no longer stalls running decodes for
+    the whole prefill. First tokens are delivered by the ``step()`` call
+    whose dispatch completes the prompt.
+  * half-prefilled sequences stay inside the resilience contracts: a chunk
+    dispatch failure (``prefill_chunk`` fault point) rolls every sequence
+    packed in that dispatch back via ``abort_sequence`` (never-fully-
+    written blocks cannot poison the prefix cache), deadlines expire
+    pending admissions BEFORE device work, and preemption may evict a
+    pending sequence (its ``Preempted.tokens`` is the bare prompt,
+    ``n_generated == 0``).
+
 Resilience contract (see README "Serving resilience"):
 
   * every boundary failure is typed (``resilience.errors``) — never a bare
@@ -99,6 +130,21 @@ class _SeqState:
 
 
 @dataclass
+class _ChunkState:
+    """Chunked-prefill progress for one PENDING admission (paged adapter):
+    KV for ``prompt[:done]`` is written (or prefix-cached — ``done`` starts
+    at the post-cut prefix-hit count); ``[done:]`` still has chunks to run.
+    The sequence graduates to a :class:`_SeqState` when its final chunk's
+    token materializes."""
+    prompt: List[int]
+    done: int                     # tokens whose KV is written/cached
+    admit_idx: int
+    t0: float                     # admission wall time (TTFT anchor)
+    deadline: Optional[float] = None
+    expired_reported: bool = False
+
+
+@dataclass
 class _Inflight:
     """One dispatched-but-not-fetched decode step (pipeline_depth >= 1).
 
@@ -142,7 +188,7 @@ class _AdapterTelemetry:
             else get_registry()
 
     def on_add(self, seq_ids: Sequence[int], prompts, t0: float,
-               live: int, padded: int):
+               live: int, padded: int, count_rows: bool = True):
         reg = self.registry
         if not reg.enabled:
             return
@@ -158,7 +204,21 @@ class _AdapterTelemetry:
         tmetrics.requests_counter(reg).inc(len(seq_ids), engine=self.engine,
                                            event="added")
         tmetrics.generated_tokens_counter(reg).inc(live, engine=self.engine)
-        self._rows(reg, "prefill", live, padded)
+        if count_rows:
+            # chunked admissions account their device rows per chunk
+            # dispatch (on_prefill_chunk) instead
+            self._rows(reg, "prefill", live, padded)
+
+    def on_prefill_chunk(self, rows: int, padded_rows: int,
+                         real_tokens: int, padded_tokens: int):
+        reg = self.registry
+        if not reg.enabled:
+            return
+        tmetrics.prefill_chunks_counter(reg).inc(rows, engine=self.engine)
+        if padded_tokens:
+            tmetrics.prefill_pad_waste_histogram(reg).observe(
+                1.0 - real_tokens / padded_tokens, engine=self.engine)
+        self._rows(reg, "prefill", rows, padded_rows)
 
     def on_step(self, live_ids: Sequence[int], t0: float, padded: int,
                 steps: int = 1):
@@ -260,14 +320,18 @@ class _AdapterTelemetry:
 
 
 def _live_rows(seqs: Dict[int, _SeqState],
-               seq_ids: Optional[Sequence[int]]) -> List[int]:
+               seq_ids: Optional[Sequence[int]],
+               pending=()) -> List[int]:
+    """Running rows for a step call. ``pending`` holds seq_ids admitted but
+    still mid-prefill (chunked admissions): they are known — not an error —
+    but carry no decodable row yet, so they are skipped."""
     ids = sorted(seqs) if seq_ids is None else list(seq_ids)
     if seq_ids is not None:
         for sid in ids:
-            if sid not in seqs:
+            if sid not in seqs and sid not in pending:
                 raise SequenceStateError(f"seq_id {sid} is not running "
                                          "(released or never added)")
-    return [sid for sid in ids if seqs[sid].running]
+    return [sid for sid in ids if sid in seqs and seqs[sid].running]
 
 
 def _validate_admission(seq_ids: Sequence[int],
@@ -478,12 +542,31 @@ class _EngineAdapterBase:
         self._ready: Dict[int, int] = {}
         self._scratch = None
         # plain-int host counters (always on — they feed the CPU
-        # host-overhead microbench, bench.py --host-overhead)
+        # microbenches, bench.py --host-overhead / --prefill-overhead).
+        # The decode counters (dispatches/blocking_fetches/...) count ONLY
+        # decode work; chunked prefill keeps its own prefill_* set so the
+        # two stay separately comparable.
         self.host_stats: Dict[str, Any] = {
             "dispatches": 0, "device_steps": 0,
-            "blocking_fetches": 0, "blocked_s": 0.0}
+            "blocking_fetches": 0, "blocked_s": 0.0,
+            "prefill_dispatches": 0, "prefill_blocking_fetches": 0,
+            "prefill_blocked_s": 0.0, "prefill_real_tokens": 0,
+            "prefill_padded_tokens": 0}
 
     # -- subclass hooks ----------------------------------------------------
+    def _pending_ids(self):
+        """seq_ids admitted but still mid-prefill (paged chunked
+        admissions); () on adapters without a deferred prefill path."""
+        return ()
+
+    def _advance_prefill(self, seq_ids=None):
+        """Run at most one packed prefill-chunk dispatch for pending
+        admissions; finished sequences' first tokens land in ``_ready``.
+        ``seq_ids`` is the step call's explicit target set (None = all):
+        an expired pending admission outside it is skipped, not raised —
+        a healthy row must not be stalled by an unrelated request's
+        budget. No-op on adapters without a deferred prefill path."""
+
     def _grow_for_step(self, live: List[int], n: int = 1) -> List[int]:
         return live
 
@@ -536,13 +619,20 @@ class _EngineAdapterBase:
         # pending drained tokens stay in self._ready until this call is
         # past every fallible stage — a recoverable DeadlineExceeded /
         # CapacityError / StepFailure must not drop them from the stream
-        live = _live_rows(self.seqs, seq_ids)
-        if not live:
+        pending = self._pending_ids()
+        live = _live_rows(self.seqs, seq_ids, pending)
+        if not live and not pending:
             return {s: [t] for s, t in self._drain_ready().items()}
         if _FAULTS.active:
             _FAULTS.fire("slow_step")
-        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry,
-                         horizon=num_steps)
+        if live:
+            _pre_step_checks(self.seqs, live, self._pos_limit,
+                             self.telemetry, horizon=num_steps)
+        # at most ONE packed prefill-chunk dispatch per horizon — the
+        # scheduler knob that keeps a long admission from stalling decode
+        self._advance_prefill(seq_ids)
+        if not live:
+            return {s: [t] for s, t in self._drain_ready().items()}
         t0 = time.perf_counter()
         live = self._grow_for_step(live, num_steps)
         if not live:
@@ -579,16 +669,22 @@ class _EngineAdapterBase:
 
     # -- eager path --------------------------------------------------------
     def _step_eager(self, seq_ids) -> Dict[int, int]:
-        live = _live_rows(self.seqs, seq_ids)
-        if not live:
-            return {}
+        pending = self._pending_ids()
+        live = _live_rows(self.seqs, seq_ids, pending)
+        if not live and not pending:
+            return self._drain_ready()
         if _FAULTS.active:
             _FAULTS.fire("slow_step")
-        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
+        if live:
+            _pre_step_checks(self.seqs, live, self._pos_limit,
+                             self.telemetry)
+        self._advance_prefill(seq_ids)
+        if not live:
+            return self._drain_ready()
         t0 = time.perf_counter()
         live = self._grow_for_step(live)
         if not live:
-            return {}
+            return self._drain_ready()
         scr = self._scratch_for(live)
         scr.fill(self)
         cache_before = self.app.cache
@@ -609,7 +705,7 @@ class _EngineAdapterBase:
                 self._decode_failure_msg + "; positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
                 retry_safe=self.app.cache is cache_before) from e
-        res = {}
+        res = self._drain_ready()    # first tokens of finished prefills
         for i, s in enumerate(live):
             st = self.seqs[s]
             st.position += 1
@@ -622,12 +718,18 @@ class _EngineAdapterBase:
 
     # -- pipelined path ----------------------------------------------------
     def _step_pipelined(self, seq_ids) -> Dict[int, int]:
-        live = _live_rows(self.seqs, seq_ids)
-        if not live:
+        pending = self._pending_ids()
+        live = _live_rows(self.seqs, seq_ids, pending)
+        if not live and not pending:
             return self.flush()
         if _FAULTS.active:
             _FAULTS.fire("slow_step")
-        _pre_step_checks(self.seqs, live, self._pos_limit, self.telemetry)
+        if live:
+            _pre_step_checks(self.seqs, live, self._pos_limit,
+                             self.telemetry)
+        self._advance_prefill(seq_ids)
+        if not live:
+            return self.flush()
         ready = self._drain_ready()
         try:
             return self._advance_pipeline(live, ready)
@@ -970,7 +1072,18 @@ class PagedEngineAdapter(_EngineAdapterBase):
     and a :class:`Preempted` record queued for :meth:`take_preempted` —
     the engine re-queues ``record.tokens`` as a fresh prompt. ``None``
     disables eviction (allocation failures then raise
-    :class:`CapacityError` after rolling the call back)."""
+    :class:`CapacityError` after rolling the call back). Pending chunked
+    admissions are eligible victims too (``tokens`` = the bare prompt,
+    ``n_generated == 0``).
+
+    ``prefill_chunk_tokens`` bounds one sequence's per-dispatch prefill
+    chunk (default: the largest ctx bucket — monolithic-equivalent, but
+    prompts longer than that bucket are still admitted by walking them in
+    bucket-sized chunks). ``prefill_budget_tokens`` defers prefill to the
+    scheduler: ``add_requests`` returns ``{}`` and each ``step()`` runs at
+    most one packed chunk dispatch of at most that many prompt tokens
+    before its decode work (first tokens arrive from the completing
+    ``step()``). Both are documented in README "Chunked prefill"."""
 
     engine_name = "paged"
     _decode_failure_msg = ("paged decode step failed; KV growth was rolled "
@@ -979,7 +1092,9 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def __init__(self, app, telemetry=None,
                  preemption_policy: Optional[str] = "lifo",
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefill_budget_tokens: Optional[int] = None):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -989,6 +1104,10 @@ class PagedEngineAdapter(_EngineAdapterBase):
             raise ConfigurationError(
                 f"unknown preemption_policy {preemption_policy!r}; expected "
                 f"one of {PREEMPTION_POLICIES} or None")
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ConfigurationError("prefill_chunk_tokens must be >= 1")
+        if prefill_budget_tokens is not None and prefill_budget_tokens < 1:
+            raise ConfigurationError("prefill_budget_tokens must be >= 1")
         self.app = app
         self.batch = cfg.batch_size
         self.seqs: Dict[int, _SeqState] = {}
@@ -998,6 +1117,16 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self._admit_counter = 0
         self._pos_limit = (None if getattr(app.spec, "rolling_window", False)
                            else cfg.seq_len)
+        # chunked prefill: width ladder clamped at the chunk bucket so
+        # chunk dispatches only ever run already-compiled ctx-bucket shapes
+        self._chunk_widths = autobucketing.prefill_chunk_buckets(
+            app.ctx_buckets, prefill_chunk_tokens)
+        self.prefill_chunk_tokens = (
+            min(prefill_chunk_tokens, self._chunk_widths[-1])
+            if prefill_chunk_tokens is not None else self._chunk_widths[-1])
+        self.prefill_budget_tokens = prefill_budget_tokens
+        self._chunks: Dict[int, _ChunkState] = {}   # pending admissions
+        self._unwritten: set = set()   # allocated blocks not fully written
         self._init_decode_path(pipeline_depth)
 
     def add_requests(self, seq_ids: Sequence[int],
@@ -1010,69 +1139,85 @@ class PagedEngineAdapter(_EngineAdapterBase):
         and cache state is exactly as before (pool pressure may still
         preempt RUNNING sequences first — that eviction is reported via
         :meth:`take_preempted` and survives a subsequent rollback, since
-        the preempted work is handed back to the engine either way)."""
-        from .modules.block_kv_cache import slots_from_table
+        the preempted work is handed back to the engine either way).
+
+        Prefill is chunked + packed (see the module docstring): the
+        uncached suffixes walk through ``prefill_chunk_tokens``-sized
+        ragged rows of shared ctx-bucket dispatches, so any prompt up to
+        ``seq_len`` is admissible. With ``prefill_budget_tokens`` set the
+        device work is deferred entirely: this call returns ``{}`` and
+        ``step()`` delivers each first token when its final chunk lands."""
+        from .modules.block_kv_cache import cut_cached_at_unwritten
         _validate_admission(seq_ids, prompts, self.app.tpu_config.seq_len)
         for sid in seq_ids:
-            if sid in self.seqs:
+            if sid in self.seqs or sid in self._chunks:
                 raise AdmissionError(f"seq_id {sid} already running")
+        live_now = len(self.seqs) + len(self._chunks)
+        if live_now + len(seq_ids) > self.batch:
+            # typed, BEFORE any state change — without this the chunked
+            # packer would happily admit (it packs <= batch rows per
+            # dispatch and loops) and the overflow would only surface as
+            # an untyped bucket error on the next decode step
+            raise AdmissionError(
+                f"admitting {len(seq_ids)} sequences would put "
+                f"{live_now + len(seq_ids)} live/pending rows on a "
+                f"compiled batch of {self.batch}")
         t0 = time.perf_counter()
         deadlines = _resolve_deadlines(deadline_s, len(seq_ids), t0)
         app = self.app
-        b = len(seq_ids)
-        lens = np.asarray([len(p) for p in prompts], np.int32)
-        cached = np.zeros((b,), np.int32)
+        bs = app.kv_mgr.spec.block_size
+        protect = frozenset(seq_ids)
         begun: List[int] = []
-        cache_before = app.cache
         try:
             for i, sid in enumerate(seq_ids):
+                prompt = list(prompts[i])
                 while True:
                     try:
-                        _, c = app.kv_mgr.begin_sequence(sid,
-                                                         list(prompts[i]))
+                        blocks, c = app.kv_mgr.begin_sequence(sid, prompt)
                         begun.append(sid)
                         break
                     except CapacityError:
-                        victim = self._choose_victim()
+                        # never evict a sibling of this very call — the
+                        # old monolithic path couldn't either (its seqs
+                        # weren't running yet), and a same-call eviction
+                        # would hollow out the return dict
+                        victim = self._choose_victim(exclude=protect)
                         if victim is None:
                             raise
                         self._preempt(victim, reason="admission")
-                cached[i] = min(c, lens[i] - 1)
-            try:
-                width = autobucketing.get_target_bucket(
-                    app.ctx_buckets, int((lens - cached).max()), kind="ctx")
-            except ValueError as e:
-                raise AdmissionError(
-                    f"prompt does not fit any context-encoding bucket: "
-                    f"{e}") from e
-            bt = app.kv_mgr.block_table_array(seq_ids,
-                                              app._bt_width_for(seq_ids))
-            ids_w = np.zeros((b, width), np.int32)
-            pos_w = np.zeros((b, width), np.int32)
-            for i, p in enumerate(prompts):
-                lo = int(cached[i])
-                n = int(lens[i] - lo)
-                ids_w[i, :n] = np.asarray(p[lo:lo + n])
-                pos_w[i] = lo + np.arange(width, dtype=np.int32)
-            valid = np.arange(width)[None, :] < (lens - cached)[:, None]
-            slots = slots_from_table(bt, np.where(valid, pos_w, -1),
-                                     app.kv_mgr.spec.block_size)
-            # repad to the compiled batch bucket (repeat row 0 - pad rows
-            # rewrite row 0's slots with identical values); without this
-            # every distinct live count would jit a fresh graph mid-serving
-            pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
-                                                     kind="batch")
-            ids_w, pos_w, slots, bt2, last = _pad_paged_rows(
-                pad_to, ids_w, pos_w, slots, bt,
-                np.maximum(lens - cached - 1, 0))
+                # a hit on a block another pending/same-call sequence has
+                # not fully written yet must be recomputed, not trusted
+                n_hit = int(c) // bs
+                c = cut_cached_at_unwritten(blocks, int(c), bs,
+                                            self._unwritten)
+                c = min(c, len(prompt) - 1)
+                self._unwritten.update(blocks[n_hit:])
+                self._admit_counter += 1
+                self._chunks[sid] = _ChunkState(
+                    prompt=prompt, done=int(c),
+                    admit_idx=self._admit_counter, t0=t0,
+                    deadline=deadlines[i])
+        except ServingError:
+            self._rollback_admission(begun)
+            raise
+        except Exception as e:
+            self._rollback_admission(begun)
+            self.telemetry.on_step_failure("prefill")
+            raise StepFailure(
+                "paged admission failed; all allocations from this call "
+                "were rolled back", phase="prefill",
+                seq_ids=seq_ids, retry_safe=True) from e
+        if self.prefill_budget_tokens is not None:
+            return {}          # deferred: step() drives the chunks
+        cache_before = app.cache
+        try:
             if _FAULTS.active:
                 _FAULTS.fire("prefill_step")
-            out = app._run_paged(ids_w, pos_w, slots, bt2, last)
-            # materialize INSIDE the try: dispatch is asynchronous, so a
-            # genuine device failure only surfaces when the tokens are
-            # fetched — it must still be wrapped and rolled back here
-            toks = np.asarray(out["tokens"]).reshape(-1)
+            while any(s in self._chunks for s in seq_ids):
+                self._prefill_step(only=protect, defer_telemetry=True)
         except ServingError:
+            # transactional: a chunk failure mid-call rolls back the WHOLE
+            # call — sequences already past their final chunk included
             self._rollback_admission(begun)
             raise
         except Exception as e:
@@ -1082,27 +1227,23 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 "paged prefill failed; all allocations from this call were "
                 "rolled back", phase="prefill", seq_ids=seq_ids,
                 retry_safe=app.cache is cache_before) from e
-        res = {}
-        # fresh block tables: a cached scratch whose row coincidentally
-        # kept its block COUNT would otherwise keep serving the old block
-        # ids (fill_block_table's append-only contract)
-        self._scratch = None
-        for i, sid in enumerate(seq_ids):
-            self._admit_counter += 1
-            self.seqs[sid] = _SeqState(
-                position=int(lens[i]), last_token=int(toks[i]),
-                tokens=list(prompts[i]) + [int(toks[i])],
-                prompt_len=int(lens[i]), admit_idx=self._admit_counter,
-                deadline=deadlines[i])
-            res[sid] = int(toks[i])
-        self.telemetry.on_add(seq_ids, prompts, t0, live=b, padded=pad_to)
-        return res
+        # telemetry only once the WHOLE call is past rollback — a sibling
+        # chunk failure must not leave spans/counters for requests that
+        # were never admitted
+        self.telemetry.on_add(seq_ids, prompts, t0, live=len(seq_ids),
+                              padded=len(seq_ids), count_rows=False)
+        return {s: self._ready.pop(s) for s in seq_ids}
 
     def release(self, seq_ids: Sequence[int]):
         if self._inflight is not None:
             self._stash_flush()
         for sid in seq_ids:
             self._ready.pop(sid, None)
+            if sid in self._chunks:
+                # mid-prefill: blocks whose content never fully landed
+                # must not survive as prefix-cache hits
+                self._abort_prefill_rows([sid])
+                continue
             if sid in self.seqs:
                 self.seqs.pop(sid)
                 self._scratch = None       # its blocks are gone; see add
@@ -1202,14 +1343,32 @@ class PagedEngineAdapter(_EngineAdapterBase):
         out, self.preempted = self.preempted, []
         return out
 
-    def _choose_victim(self) -> Optional[int]:
+    def _choose_victim(self, exclude=frozenset()) -> Optional[int]:
         if self.preemption_policy is None:
             return None
         cands = [(sid, st.admit_idx, len(st.tokens) - st.prompt_len)
-                 for sid, st in self.seqs.items() if st.running]
+                 for sid, st in self.seqs.items()
+                 if st.running and sid not in exclude]
+        # pending chunked admissions are victims too (zero generated
+        # tokens: they lose the least decode work of anything live)
+        cands += [(sid, cst.admit_idx, 0)
+                  for sid, cst in self._chunks.items()
+                  if sid not in exclude]
         return pick_victim(self.preemption_policy, cands)
 
     def _preempt(self, victim: int, reason: str):
+        self._ready.pop(victim, None)      # replay regenerates it
+        cst = self._chunks.pop(victim, None)
+        if cst is not None:
+            # half-prefilled victim: blocks not fully written must leave
+            # the prefix cache (abort, not a plain free); the record's
+            # tokens are the bare prompt — nothing was generated yet
+            self._abort_pending(victim)
+            self.preempted.append(Preempted(
+                seq_id=victim, tokens=tuple(cst.prompt),
+                prompt_len=len(cst.prompt), n_generated=0, reason=reason))
+            self.telemetry.on_preempt(victim, reason)
+            return
         st = self.seqs.pop(victim)
         self._scratch = None               # victim's blocks are reclaimed
         if victim in self.app.kv_mgr.tables:
@@ -1254,11 +1413,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
         for s in live:
             self.app.kv_mgr.shrink(s, n)
 
-    def _rollback_admission(self, begun: Sequence[int]):
+    def _rollback_admission(self, seq_ids: Sequence[int]):
         """Abort every sequence begun by the failing add_requests call:
         frees its blocks and purges never-written content hashes from the
         prefix cache (the free count is restored exactly; prefix-HIT
-        blocks whose content predates the call stay resident).
+        blocks whose content predates the call stay resident). Sequences
+        that already finished their prefill inside the call are unwound
+        too — admission is all-or-nothing.
 
         Reverse admission order matters: when prompts within the call
         share a prefix, later sequences prefix-HIT blocks the first one
@@ -1266,7 +1427,220 @@ class PagedEngineAdapter(_EngineAdapterBase):
         makes the ORIGINATING sequence's abort the last dereference, so
         its invalidate (not a later sibling's plain free) retires the
         never-written hash."""
-        for sid in reversed(begun):
-            if sid in self.app.kv_mgr.tables:
-                self.app.kv_mgr.abort_sequence(sid)
+        for sid in reversed(list(seq_ids)):
+            self._chunks.pop(sid, None)
+            self._ready.pop(sid, None)
+            if self.seqs.pop(sid, None) is not None:
+                self._scratch = None
+            self._abort_pending(sid)
         self.telemetry.on_admission_rollback()
+
+    # -- chunked, packed, schedulable prefill ------------------------------
+    def _pending_ids(self):
+        return self._chunks.keys()
+
+    def _advance_prefill(self, seq_ids=None):
+        if self._chunks:
+            self._prefill_step(budget=self.prefill_budget_tokens,
+                               target=seq_ids)
+
+    def _prefill_step(self, budget: Optional[int] = None, only=None,
+                      target=None, defer_telemetry: bool = False):
+        """ONE packed chunk dispatch: pending sequences (admission order)
+        each contribute their next uncached-suffix chunk as a ragged row
+        of a single ctx-bucket ``_run_paged`` call, bounded by ``budget``
+        real prompt tokens (None = unbounded). Sequences whose FINAL chunk
+        lands graduate to running rows with their first token stashed in
+        ``_ready``; intermediate samples are discarded. A dispatch failure
+        rolls every sequence packed in THIS dispatch back
+        (:meth:`~..modules.block_kv_cache.BlockKVCacheManager.abort_sequence`)
+        and raises a typed :class:`StepFailure`. ``defer_telemetry`` (the
+        transactional add_requests path) suppresses per-sequence admission
+        telemetry — the caller reports the whole call only once it is past
+        rollback. ``target`` is the step call's explicit seq_ids set (None
+        = all): an expired pending admission is raised only when targeted,
+        merely skipped from packing otherwise."""
+        chunks = self._chunks
+        order = sorted(chunks, key=lambda s: chunks[s].admit_idx)
+        if only is not None:
+            order = [s for s in order if s in only]
+        now = time.perf_counter()
+        expired = [s for s in order if chunks[s].deadline is not None
+                   and now >= chunks[s].deadline]
+        if expired:
+            hit = (expired if target is None
+                   else [s for s in expired if s in set(target)])
+            if hit:
+                fresh = [s for s in hit if not chunks[s].expired_reported]
+                for s in fresh:
+                    chunks[s].expired_reported = True
+                self.telemetry.on_deadline(fresh)
+                raise DeadlineExceeded(
+                    f"seq_ids {hit} exceeded their wall-clock deadline "
+                    "mid-prefill; release() them (or re-queue with a fresh "
+                    "budget) and step again", seq_ids=hit)
+            # expired but not targeted by this step: don't burn budget on
+            # them, and don't stall the targeted healthy rows
+            order = [s for s in order if s not in expired]
+        rows: List[Tuple[int, int, int, bool]] = []
+        left = float("inf") if budget is None else int(budget)
+        for s in order:
+            if len(rows) == self.batch or left < 1:
+                break
+            st = chunks[s]
+            n = int(min(len(st.prompt) - st.done,
+                        self.prefill_chunk_tokens, left))
+            rows.append((s, st.done, n, st.done + n == len(st.prompt)))
+            left -= n
+        if not rows:
+            return
+        seq_list = tuple(s for s, *_ in rows)
+        final_rows = [(i, s) for i, (s, _, _, fin) in enumerate(rows)
+                      if fin]
+        cache_before = self.app.cache
+        try:
+            if _FAULTS.active:
+                _FAULTS.fire("prefill_chunk")
+            packed = self._pack_prefill_rows(rows)
+            out = self._dispatch_prefill_chunk(packed,
+                                               fetch=bool(final_rows))
+            # materialize INSIDE the try (dispatch is asynchronous): a
+            # genuine device failure surfacing at the fetch must still be
+            # wrapped and rolled back here. Intermediate-only dispatches
+            # fetch nothing — their samples are discarded unmaterialized.
+            new = (self._fetch_prefill_tokens(out) if final_rows
+                   else None)
+        except ServingError:
+            self._abort_prefill_rows(seq_list)
+            raise
+        except Exception as e:
+            self._abort_prefill_rows(seq_list)
+            self.telemetry.on_step_failure("prefill")
+            raise StepFailure(
+                "chunked prefill dispatch failed; every partially-"
+                "prefilled sequence packed in it was rolled back",
+                phase="prefill", seq_ids=seq_list,
+                retry_safe=self.app.cache is cache_before) from e
+        bs = self.app.kv_mgr.spec.block_size
+        for s, _, n, _ in rows:
+            chunks[s].done += n
+        if final_rows:
+            # this dispatch's tokens were MATERIALIZED, and the donated
+            # cache chain orders every earlier dispatch before it — all
+            # covered blocks are now confirmed written. Unfetched
+            # intermediate dispatches confirm nothing: a genuine async
+            # device failure in one surfaces at a later fetch, and the
+            # rollback there must still find their blocks in _unwritten
+            # (or their allocate-time hashes would be freed as valid).
+            for s2, cst in chunks.items():
+                self._unwritten.difference_update(
+                    self.app.kv_mgr.tables[s2][:cst.done // bs])
+        pad_rows, width = packed[0].shape
+        real = sum(n for _, _, n, _ in rows)
+        self.host_stats["prefill_real_tokens"] += real
+        self.host_stats["prefill_padded_tokens"] += pad_rows * width
+        self.telemetry.on_prefill_chunk(len(rows), pad_rows, real,
+                                        pad_rows * width)
+        for i, s in final_rows:
+            st = chunks.pop(s)
+            self._unwritten.difference_update(self.app.kv_mgr.tables[s])
+            tok = int(new[i, 0])
+            self.seqs[s] = _SeqState(
+                position=len(st.prompt), last_token=tok,
+                tokens=list(st.prompt) + [tok],
+                prompt_len=len(st.prompt), admit_idx=st.admit_idx,
+                deadline=st.deadline)
+            self._scratch = None   # live set grew; see add_requests note
+            self._ready[s] = tok
+            if not defer_telemetry:
+                self.telemetry.on_add([s], [st.prompt], st.t0, live=1,
+                                      padded=1, count_rows=False)
+
+    def _pack_prefill_rows(self, rows):
+        """Build the ragged packed-chunk inputs: one row per sequence,
+        positions at each row's own suffix offset, slots through its own
+        block table; width = smallest ctx bucket covering the longest
+        chunk, batch padded by repeating row 0 (the usual invariant)."""
+        from .modules.block_kv_cache import slots_from_table
+        app = self.app
+        b = len(rows)
+        width = autobucketing.get_target_bucket(
+            self._chunk_widths, max(n for _, _, n, _ in rows), kind="ctx")
+        sids = [s for s, *_ in rows]
+        bt = app.kv_mgr.block_table_array(sids, app._bt_width_for(sids))
+        ids_w = np.zeros((b, width), np.int32)
+        pos_w = np.zeros((b, width), np.int32)
+        slot_pos = np.full((b, width), -1, np.int32)
+        last = np.zeros((b,), np.int32)
+        for i, (s, lo, n, fin) in enumerate(rows):
+            st = self._chunks[s]
+            ids_w[i, :n] = st.prompt[lo:lo + n]
+            pos_w[i] = lo + np.arange(width, dtype=np.int32)
+            slot_pos[i, :n] = pos_w[i, :n]
+            if fin:
+                last[i] = n - 1
+        slots = slots_from_table(bt, slot_pos, app.kv_mgr.spec.block_size)
+        pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
+                                                 kind="batch")
+        return _pad_paged_rows(pad_to, ids_w, pos_w, slots, bt, last)
+
+    def _dispatch_prefill_chunk(self, packed, fetch: bool = True):
+        """Issue ONE packed prefill-chunk dispatch without materializing
+        any output (region lint: scripts/check_host_sync.py) — the final-
+        chunk token fetch happens in the caller, one async hop behind.
+        ``fetch=False`` (intermediate-only dispatch) skips even the async
+        device-to-host copy: those samples are never read."""
+        ids_p, pos_p, slots_p, bt_p, last_p = packed
+        out = self.app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
+        if fetch:
+            _async_fetch(out["tokens"])
+        self.host_stats["prefill_dispatches"] += 1
+        return out
+
+    def _fetch_prefill_tokens(self, out) -> np.ndarray:
+        """Materialize a final-chunk dispatch's sampled tokens (the one
+        blocking sync of a packed admission; async-prefetched)."""
+        t0 = time.perf_counter()
+        toks = np.asarray(out["tokens"])
+        self.host_stats["prefill_blocking_fetches"] += 1
+        self.host_stats["prefill_blocked_s"] += time.perf_counter() - t0
+        return toks.reshape(toks.shape[0], -1)
+
+    def _drop_unwritten(self, sid):
+        """Retire ``sid``'s EXCLUSIVE blocks from the unwritten set. Any
+        block another still-pending sequence shares stays: a shared prefix
+        block keeps its registered hash while any holder references it,
+        so its unwritten-ness must keep being tracked until the last
+        pending holder confirms the write or tears down."""
+        tbl = set(self.app.kv_mgr.tables.get(sid, ()))
+        if not tbl:
+            return
+        for other in self._chunks:
+            if other != sid:
+                tbl.difference_update(self.app.kv_mgr.tables.get(other, ()))
+        self._unwritten -= tbl
+
+    def _abort_pending(self, sid):
+        """Tear down one pending/rolled-back sequence's allocations: every
+        block whose content never fully landed — the sequence's own
+        unwritten tail AND prefix hits on another pending writer's
+        still-unwritten blocks — is invalidated so the prefix cache can
+        never serve it; fully-written blocks are freed as valid. The
+        caller pops the ``_ChunkState`` first."""
+        if sid not in self.app.kv_mgr.tables:
+            return
+        unwritten = set(self.app.kv_mgr.tables[sid]) & self._unwritten
+        self._drop_unwritten(sid)
+        self.app.kv_mgr.abort_sequence(sid, unwritten=unwritten)
+
+    def _abort_prefill_rows(self, sids):
+        """Transactional rollback of partially-prefilled sequences: drop
+        their chunk state and abort their allocations — blocks whose
+        content never fully landed are invalidated (they must not be
+        served as prefix hits), fully-written blocks freed normally.
+        REVERSE admission order, like :meth:`_rollback_admission`: the
+        originating sequence's invalidate must be the last dereference of
+        an intra-call shared-prefix hash."""
+        for s in reversed(list(sids)):
+            self._chunks.pop(s, None)
+            self._abort_pending(s)

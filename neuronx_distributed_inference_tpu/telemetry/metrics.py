@@ -20,6 +20,10 @@ LIVE_ROWS_TOTAL = "nxdi_live_rows_total"              # phase=prefill|decode
 PAD_ROWS_TOTAL = "nxdi_pad_rows_total"                # phase=prefill|decode
 REQUESTS_TOTAL = "nxdi_requests_total"                # event=added|released
 
+# -- chunked prefill (serving.py PagedEngineAdapter) -------------------------
+PREFILL_CHUNKS_TOTAL = "nxdi_prefill_chunks_total"      # engine
+PREFILL_PAD_WASTE = "nxdi_prefill_pad_waste"            # engine
+
 # -- decode pipeline (serving.py) --------------------------------------------
 DISPATCH_DEPTH = "nxdi_dispatch_depth"                  # engine
 HOST_OVERLAP_SECONDS = "nxdi_host_overlap_seconds"      # engine
@@ -96,6 +100,24 @@ def pad_rows_counter(reg):
 def requests_counter(reg):
     return reg.counter(REQUESTS_TOTAL, "Engine request lifecycle events",
                        labels=("engine", "event"))
+
+
+def prefill_chunks_counter(reg):
+    return reg.counter(
+        PREFILL_CHUNKS_TOTAL,
+        "Prompt chunks driven through the packed paged prefill path "
+        "(one per sequence per packed chunk dispatch)",
+        labels=("engine",))
+
+
+def prefill_pad_waste_histogram(reg):
+    return reg.histogram(
+        PREFILL_PAD_WASTE,
+        "Padded-token waste fraction of one packed prefill dispatch "
+        "((padded - real) / padded over the rows x width grid; monolithic "
+        "admission of skewed prompts pushes this toward 1)",
+        labels=("engine",),
+        buckets=(0.05, 0.1, 0.2, 0.4, 0.6, 0.8, 0.95))
 
 
 def dispatch_depth_gauge(reg):

@@ -17,13 +17,23 @@ by contract, so any array construction inside the region is a smell and
 gets flagged too. The blocking fetch belongs in the retire/fetch helpers
 (``_retire`` / ``_fetch_rows``), which run one step behind the dispatch.
 
+The chunked-prefill path is covered the same way: the packed
+chunk-dispatch region (``_dispatch_prefill_chunk``) must only issue the
+device call and start the async copy — final-chunk tokens are fetched by
+the caller, one async hop behind. When the default file set is linted,
+the EXPECTED_REGIONS guard additionally fails the lint if a required
+region function disappears (a rename would otherwise silently drop its
+coverage).
+
 Usage::
 
-    python scripts/check_host_sync.py            # lint the default set
-    python scripts/check_host_sync.py FILE...    # lint specific files
+    python scripts/check_host_sync.py                 # lint the default set
+    python scripts/check_host_sync.py FILE...         # lint specific files
+    python scripts/check_host_sync.py --list-regions  # show linted regions
 
-Wired into the test suite as a tier-1 test
-(``tests/test_decode_pipeline.py::test_host_sync_lint``).
+Wired into the test suite as tier-1 tests
+(``tests/test_decode_pipeline.py::test_host_sync_lint`` and
+``tests/test_chunked_prefill.py::test_chunk_dispatch_region_linted``).
 """
 
 from __future__ import annotations
@@ -41,6 +51,21 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving.py",
 )
+# region functions that MUST exist when linting the default set — a rename
+# must move coverage, not lose it
+EXPECTED_REGIONS = {
+    "neuronx_distributed_inference_tpu/serving.py": (
+        "_dispatch_decode",           # decode pipeline (both adapters)
+        "_dispatch_prefill_chunk",    # packed chunked prefill (paged)
+    ),
+}
+
+
+def region_functions(source: str) -> List[str]:
+    """Names of every dispatch-region function in ``source``."""
+    return [node.name for node in ast.walk(ast.parse(source))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name.startswith(REGION_PREFIX)]
 
 
 def blocking_calls(source: str) -> List[Tuple[int, str, str]]:
@@ -62,6 +87,10 @@ def blocking_calls(source: str) -> List[Tuple[int, str, str]]:
 
 
 def main(argv: Sequence[str] = ()) -> int:
+    argv = list(argv)
+    list_regions = "--list-regions" in argv
+    argv = [a for a in argv if a != "--list-regions"]
+    default_set = not argv
     paths = [Path(p) for p in argv] if argv else \
         [REPO_ROOT / p for p in DEFAULT_PATHS]
     rc = 0
@@ -70,13 +99,28 @@ def main(argv: Sequence[str] = ()) -> int:
             print(f"check_host_sync: {path}: missing", file=sys.stderr)
             rc = 1
             continue
-        for lineno, func, attr in blocking_calls(path.read_text()):
+        source = path.read_text()
+        if list_regions:
+            for name in region_functions(source):
+                print(f"{path}: {name}")
+        for lineno, func, attr in blocking_calls(source):
             print(f"{path}:{lineno}: .{attr}(...) inside dispatch-region "
                   f"function {func!r} — device output must not be "
                   "materialized before retire/fetch (decode pipeline "
                   "contract)", file=sys.stderr)
             rc = 1
-    if rc == 0:
+        if default_set:
+            rel = path.relative_to(REPO_ROOT).as_posix()
+            found = set(region_functions(source))
+            for required in EXPECTED_REGIONS.get(rel, ()):
+                if required not in found:
+                    print(f"check_host_sync: {path}: expected dispatch "
+                          f"region {required!r} is gone — renamed regions "
+                          "must keep the _dispatch prefix (and this list "
+                          "updated) or the lint loses coverage",
+                          file=sys.stderr)
+                    rc = 1
+    if rc == 0 and not list_regions:
         print(f"check_host_sync: OK ({len(paths)} file(s) clean)")
     return rc
 

@@ -23,6 +23,25 @@ except AttributeError:
     pass
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent XLA compile cache shared across the whole suite (and inherited
+# by subprocess tests through the env var): the tier-1 wall clock is
+# dominated by recompiling the same tiny graphs in every module, and the
+# 870s budget is tight on slow host phases. Content-addressed, safe to
+# share; min_compile_time 0 caches even the tiny graphs.
+import tempfile  # noqa: E402
+
+_xla_cache = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(),
+                 "nxdi_tpu_test_xla_cache_%s" % os.environ.get("USER",
+                                                               "root")))
+os.makedirs(_xla_cache, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _xla_cache)
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except AttributeError:  # older jax spelling
+    pass
+
 # older-jax API shims (set_mesh / get_abstract_mesh / shard_map); no-op on
 # current jax — also applied by the package import, kept explicit here
 from neuronx_distributed_inference_tpu.compat import \
