@@ -196,6 +196,149 @@ def prefill_overhead_main(artifact_path="artifacts/bench_prefill_r07.json"):
               file=sys.stderr)
 
 
+def serving_load_main(artifact_path="artifacts/bench_serving_r08.json"):
+    """CPU-runnable closed-loop serving-load microbench (ISSUE 6): drives
+    the multi-tenant ServingEngine over the paged adapter with a 2x
+    oversubscribed three-tenant arrival trace on the tiny synthetic model
+    and reports client-observed TTFT/TPOT p50/p99, the weighted fairness
+    ratio (per-tenant tokens/s normalized by weight, min/max across
+    tenants — 1.0 is perfectly weight-proportional), and preemption /
+    requeue counts. One parseable JSON line + an artifact file; no TPU
+    required (reference yardstick for WHAT a TPU serving stack reports:
+    the Gemma-on-Cloud-TPU comparison, PAPERS.md arxiv 2605.25645)."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
+
+    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, head_dim=16, vocab_size=512,
+              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
+              tie_word_embeddings=False, torch_dtype="float32")
+    batch, max_new, prompt_len = 8, 16, 10
+    weights = {"a": 1.0, "b": 1.0, "c": 2.0}
+    # closed loop at 2x oversubscription: each tenant keeps twice its
+    # weighted slot share in flight and replaces a finished request with
+    # the next from its quota (quotas weight-proportional, so every
+    # tenant's trace spans the same steady-state window)
+    slot_share = {t: int(batch * w / sum(weights.values()))
+                  for t, w in weights.items()}
+    outstanding_target = {t: 2 * s for t, s in slot_share.items()}
+    quota = {t: 4 * s for t, s in slot_share.items()}
+    # one slot-share worth of each tenant's quota is held back and injected
+    # as a single high-priority burst at the halfway mark — it arrives
+    # while the batch is FULL, so it exercises scheduler-driven preemption
+    # + requeue (a closed loop alone admits high-priority work through
+    # freed slots and never needs to evict); per-tenant totals stay
+    # weight-proportional so the fairness measurement is undisturbed
+    reserve = dict(slot_share)
+    quota_normal = {t: quota[t] - reserve[t] for t in weights}
+
+    tcfg = TpuConfig(batch_size=batch, seq_len=128, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_block_kv_layout=True, pa_block_size=16,
+                     is_prefix_caching=True)
+    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                   LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    adapter = PagedEngineAdapter(app, prefill_budget_tokens=32)
+    eng = ServingEngine(adapter, tenant_weights=weights,
+                        starvation_bound_s=30.0)
+
+    rng = np.random.default_rng(0)
+    records = []          # [tenant, stream, t_submit, t_first, t_done]
+    submitted = {t: 0 for t in weights}
+
+    def submit_one(t, now, prio=0):
+        prompt = rng.integers(1, 500, size=prompt_len).tolist()
+        stream = eng.submit(prompt, max_new, tenant=t, priority=prio)
+        records.append([t, stream, now, None, None])
+        submitted[t] += 1
+
+    def top_up(now):
+        for t in weights:
+            live = sum(1 for r in records
+                       if r[0] == t and r[4] is None)
+            while (live < outstanding_target[t]
+                   and submitted[t] < quota_normal[t]):
+                submit_one(t, now)
+                live += 1
+
+    total = sum(quota.values())
+    burst_done = False
+    t_start = time.perf_counter()
+    while True:
+        now = time.perf_counter()
+        top_up(now)
+        if not burst_done and eng.stats["completed"] >= total // 2:
+            burst_done = True
+            for t in weights:
+                for _ in range(reserve[t]):
+                    submit_one(t, now, prio=5)
+        if not eng.has_work:
+            break
+        eng.run_pass()
+        now = time.perf_counter()
+        for rec in records:
+            if rec[3] is None and rec[1].tokens:
+                rec[3] = now
+            if rec[4] is None and rec[1].finished:
+                rec[4] = now
+    wall = time.perf_counter() - t_start
+
+    assert all(r[1].finish_reason == "length" for r in records)
+    ttft = np.asarray([r[3] - r[2] for r in records])
+    tpot = np.asarray([(r[4] - r[3]) / (max_new - 1) for r in records])
+    per_tenant_tok_s = {
+        t: sum(len(r[1].tokens) for r in records if r[0] == t) / wall
+        for t in weights}
+    norm = {t: per_tenant_tok_s[t] / weights[t] for t in weights}
+    fairness = min(norm.values()) / max(norm.values())
+
+    pct = lambda a, q: float(np.percentile(a, q) * 1e3)  # noqa: E731
+    payload = {
+        "metric": "serving_load_weighted_fairness",
+        "value": round(fairness, 4),
+        "unit": "min_over_max_weight_normalized_tok_s",
+        "details": {
+            "requests": len(records),
+            "oversubscription": 2.0,
+            "tenant_weights": weights,
+            "per_tenant_tok_s": {t: round(v, 2)
+                                 for t, v in per_tenant_tok_s.items()},
+            "ttft_ms": {"p50": round(pct(ttft, 50), 2),
+                        "p99": round(pct(ttft, 99), 2)},
+            "tpot_ms": {"p50": round(pct(tpot, 50), 2),
+                        "p99": round(pct(tpot, 99), 2)},
+            "preempt_requeues": eng.stats["preempt_requeues"],
+            "priority_preemptions": eng.stats["priority_preemptions"],
+            "completed": eng.stats["completed"],
+            "wall_s": round(wall, 2),
+            "batch": batch,
+            "max_new_tokens": max_new,
+            "prefill_budget_tokens": 32,
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(payload))
+    try:
+        os.makedirs(os.path.dirname(artifact_path), exist_ok=True)
+        with open(artifact_path, "w") as f:
+            json.dump(payload, f, indent=1)
+    except OSError as e:  # pragma: no cover - diagnostics only
+        print(f"serving-load artifact write failed: {e}", file=sys.stderr)
+
+
 def _no_tpu_fallback(error: str):
     """No TPU (or the backend failed to initialize): the throughput bench
     cannot run, but the CPU microbenches CAN — emit their numbers so
@@ -204,7 +347,8 @@ def _no_tpu_fallback(error: str):
     trajectories and must stay distinguishable)."""
     extra = {}
     for name, fn in (("host_overhead", host_overhead_main),
-                     ("prefill_overhead", prefill_overhead_main)):
+                     ("prefill_overhead", prefill_overhead_main),
+                     ("serving_load", serving_load_main)):
         try:
             fn()
         except Exception as e:  # pragma: no cover - defensive
@@ -235,6 +379,8 @@ def main():
         return host_overhead_main()
     if "--prefill-overhead" in sys.argv[1:]:
         return prefill_overhead_main()
+    if "--serving-load" in sys.argv[1:]:
+        return serving_load_main()
     # probe the backend FIRST: on a machine with no TPU the bench must emit a
     # clearly-marked skip (one parseable JSON line, rc=0) — "no hardware" and
     # "regression" are different trajectories and must stay distinguishable.

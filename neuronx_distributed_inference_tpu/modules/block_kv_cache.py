@@ -266,6 +266,28 @@ class BlockAllocator:
             blocks.append(blk)
         return blocks, cached_tokens
 
+    def probe(self, token_ids: Sequence[int]) -> Tuple[int, List[int]]:
+        """READ-ONLY prefix-warmth probe: (cached_tokens, hit block ids)
+        that :meth:`allocate` WOULD serve from the prefix cache right now.
+        Unlike allocate it takes no references, touches no LRU order and
+        registers no hashes — schedulers call it per queued request to
+        order admissions warm-first, so it must not perturb cache state."""
+        if not self.enable_prefix_caching:
+            return 0, []
+        parent = b""
+        blocks: List[int] = []
+        cached = 0
+        for bi in range(len(token_ids) // self.block_size):
+            chunk = token_ids[bi * self.block_size:
+                              (bi + 1) * self.block_size]
+            parent = _hash_block(parent, chunk)
+            blk = self.hash_to_block.get(parent)
+            if blk is None:
+                break
+            blocks.append(blk)
+            cached += self.block_size
+        return cached, blocks
+
     def extend(self, blocks: List[int], new_len: int) -> List[int]:
         """Grow a running sequence's block list to cover ``new_len`` tokens.
         On OOM the blocks added by this call are rolled back."""
@@ -356,6 +378,24 @@ class NativeBlockAllocator:
         if n < 0:
             raise CapacityError("out of KV cache blocks")
         return list(out[:n]), int(cached.value)
+
+    def probe(self, token_ids: Sequence[int]) -> Tuple[int, List[int]]:
+        """Read-only prefix-warmth probe (see :meth:`BlockAllocator.probe`).
+        Returns cold (0, []) under a pre-probe ``libnxdi_native.so`` that
+        was built before ``nxdi_alloc_probe`` existed — warmth ordering is
+        an optimization, never a correctness dependency."""
+        if not self.enable_prefix_caching:
+            return 0, []
+        fn = getattr(self._lib, "nxdi_alloc_probe", None)
+        if fn is None:  # pragma: no cover - stale cached library
+            return 0, []
+        ct = self._ct
+        toks = np.ascontiguousarray(np.asarray(token_ids, np.int64))
+        max_out = max(1, len(toks) // self.block_size)
+        out = (ct.c_int * max_out)()
+        cached = fn(self._h, toks.ctypes.data_as(ct.POINTER(ct.c_int64)),
+                    len(toks), out, max_out)
+        return int(cached), list(out[:cached // self.block_size])
 
     def extend(self, blocks: List[int], new_len: int) -> List[int]:
         ct = self._ct
@@ -515,6 +555,17 @@ class BlockKVCacheManager:
             self.allocator.invalidate(
                 [b for b in blocks if b in unwritten])
         self._tel_occupancy()
+
+    def probe_cached_tokens(self, token_ids: Sequence[int]
+                            ) -> Tuple[int, List[int]]:
+        """READ-ONLY prefix-warmth probe: (cached_tokens, hit block ids)
+        a :meth:`begin_sequence` of ``token_ids`` would currently serve
+        from the prefix cache. No references are taken and no LRU/hash
+        state moves — safe to call per queued request. The serving engine
+        uses it to admit warm-prefix requests first; callers holding
+        pending (unwritten) admissions must additionally cut the count at
+        the first unwritten block (:func:`cut_cached_at_unwritten`)."""
+        return self.allocator.probe(list(token_ids))
 
     def block_table_array(self, seq_ids: Sequence[int], max_blocks: int
                           ) -> np.ndarray:

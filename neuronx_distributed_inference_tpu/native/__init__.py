@@ -88,5 +88,10 @@ def load_library() -> Optional[ctypes.CDLL]:
                                               ctypes.c_int]
         lib.nxdi_alloc_num_free.restype = ctypes.c_int
         lib.nxdi_alloc_num_free.argtypes = [ctypes.c_void_p]
+        if hasattr(lib, "nxdi_alloc_probe"):  # absent in pre-probe builds
+            lib.nxdi_alloc_probe.restype = ctypes.c_int
+            lib.nxdi_alloc_probe.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.c_int]
         _lib = lib
         return _lib

@@ -120,6 +120,27 @@ class Allocator {
     return n_blocks;
   }
 
+  // READ-ONLY prefix-warmth probe: how many leading tokens allocate()
+  // would serve from the prefix cache right now, writing the hit block
+  // ids to out_blocks. Takes no references, touches no LRU order — a
+  // scheduler calls this per queued request to order admissions.
+  int probe(const int64_t* tokens, int n_tokens, int* out_blocks,
+            int max_out) const {
+    if (!prefix_) return 0;
+    int cached = 0;
+    uint64_t parent = 0;
+    int n_full = n_tokens / block_size_;
+    for (int bi = 0; bi < n_full && bi < max_out; ++bi) {
+      const int64_t* chunk = tokens + static_cast<int64_t>(bi) * block_size_;
+      parent = hash_block(parent, chunk, block_size_);
+      auto it = hash_to_block_.find(parent);
+      if (it == hash_to_block_.end()) break;
+      out_blocks[bi] = it->second;
+      cached += block_size_;
+    }
+    return cached;
+  }
+
   // grow blocks to cover new_len tokens; returns new count or -1
   // (rolling back this call's additions on OOM)
   int extend(int* blocks, int n_blocks, int new_len, int max_out) {
@@ -251,6 +272,12 @@ int nxdi_alloc_invalidate(void* a, const int* blocks, int n) {
 
 int nxdi_alloc_num_free(void* a) {
   return static_cast<Allocator*>(a)->num_free();
+}
+
+int nxdi_alloc_probe(void* a, const int64_t* tokens, int n_tokens,
+                     int* out_blocks, int max_out) {
+  return static_cast<Allocator*>(a)->probe(tokens, n_tokens, out_blocks,
+                                           max_out);
 }
 
 }  // extern "C"

@@ -9,16 +9,17 @@ preemption under KV pressure, deadline budgets — is exercised on CPU by
 arming the fault points in :mod:`.faults`; no TPU, no flakiness.
 """
 
-from .errors import (AdmissionError, CapacityError, ConfigurationError,
-                     DeadlineExceeded, KVCacheStateError, SequenceStateError,
-                     ServingError, StepFailure)
+from .errors import (AdmissionError, Cancelled, CapacityError,
+                     ConfigurationError, DeadlineExceeded, KVCacheStateError,
+                     QueueOverflow, SequenceStateError, ServingError,
+                     StepFailure)
 from .faults import FAULT_POINTS, FAULTS, FaultInjector, InjectedFault
 from .preemption import PREEMPTION_POLICIES, Preempted, pick_victim
 
 __all__ = [
     "ServingError", "AdmissionError", "CapacityError", "ConfigurationError",
     "DeadlineExceeded", "KVCacheStateError", "SequenceStateError",
-    "StepFailure",
+    "StepFailure", "QueueOverflow", "Cancelled",
     "FAULTS", "FAULT_POINTS", "FaultInjector", "InjectedFault",
     "Preempted", "PREEMPTION_POLICIES", "pick_victim",
 ]

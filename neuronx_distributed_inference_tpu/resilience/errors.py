@@ -20,7 +20,7 @@ from typing import Sequence, Tuple
 __all__ = [
     "ServingError", "AdmissionError", "SequenceStateError",
     "ConfigurationError", "CapacityError", "KVCacheStateError",
-    "DeadlineExceeded", "StepFailure",
+    "DeadlineExceeded", "StepFailure", "QueueOverflow", "Cancelled",
 ]
 
 
@@ -67,6 +67,21 @@ class DeadlineExceeded(ServingError, TimeoutError):
     Raised by ``step()`` BEFORE any device work: the engine should
     ``release(exc.seq_ids)`` (or re-queue with a fresh deadline) and step
     again. Carries the offending ids in :attr:`seq_ids`."""
+
+
+class QueueOverflow(CapacityError):
+    """The serving engine's request queue is at ``max_queue_depth``:
+    admission control rejected the submit before it consumed any engine
+    or device state. A load balancer should shed or retry elsewhere.
+    Subclasses :class:`CapacityError` so capacity-aware callers handle
+    both with one clause."""
+
+
+class Cancelled(ServingError):
+    """The request was cancelled (explicit ``cancel()`` call or the
+    streaming client went away). Queued entries are dropped without any
+    device work; running sequences are released and their KV blocks
+    reclaimed. Delivered tokens remain valid."""
 
 
 class StepFailure(ServingError, RuntimeError):

@@ -11,8 +11,9 @@ uninterrupted run (pinned by ``tests/test_resilience.py``).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
-from typing import Iterable, Optional, Tuple
+from typing import Any, Dict, Iterable, Optional, Tuple
 
 __all__ = ["Preempted", "PREEMPTION_POLICIES", "pick_victim"]
 
@@ -32,13 +33,45 @@ class Preempted:
     ``tokens`` is the full recompute prompt: original prompt + generated
     tokens (the last of which had been sampled but not yet written to KV —
     re-prefilling writes it and samples its successor, exactly as the
-    interrupted decode would have)."""
+    interrupted decode would have).
+
+    The record carries everything a requeue needs: ``deadline`` is the
+    victim's ABSOLUTE ``perf_counter()`` deadline (None = unbounded) and
+    ``meta`` is the adapter's opaque per-request passthrough (the serving
+    engine parks tenant/priority/request identity there), so a scheduler
+    never reconstructs admission arguments by hand —
+    :meth:`admission_kwargs` splats straight into ``add_requests``.
+    Sampling state needs no field: the adapters decode greedily, so the
+    recompute prompt IS the complete sampling state and the replayed
+    continuation is bit-identical (pinned from the adapter path by
+    tests/test_resilience.py and from the engine path by
+    tests/test_serving_engine.py)."""
 
     seq_id: int
     tokens: Tuple[int, ...]
     prompt_len: int
     n_generated: int
-    reason: str                    # "grow" | "admission"
+    reason: str                    # "grow" | "admission" | "scheduler"
+    deadline: Optional[float] = None   # absolute perf_counter() deadline
+    meta: Any = None                   # engine passthrough (tenant, ...)
+
+    def admission_kwargs(self, seq_id: Optional[int] = None,
+                         now: Optional[float] = None) -> Dict[str, Any]:
+        """Keyword arguments that re-admit this record through
+        ``PagedEngineAdapter.add_requests(**kwargs)``: the recompute
+        prompt, the REMAINING relative deadline budget (the victim's
+        clock keeps running while it waits), and the meta passthrough.
+        ``seq_id`` defaults to the evicted id — pass a fresh one when the
+        old id may have been reused."""
+        if now is None:
+            now = time.perf_counter()
+        return {
+            "seq_ids": [self.seq_id if seq_id is None else seq_id],
+            "prompts": [list(self.tokens)],
+            "deadline_s": [None if self.deadline is None
+                           else max(self.deadline - now, 0.0)],
+            "meta": [self.meta],
+        }
 
 
 def pick_victim(policy: str,

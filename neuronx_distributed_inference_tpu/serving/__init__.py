@@ -1,0 +1,16 @@
+"""Serving package: the engine adapters (``serving.adapter`` — the
+importable continuous-batching contract over both applications) and the
+multi-tenant serving engine built on top of the paged adapter
+(``serving.engine`` — queue + scheduler + token streams + HTTP/SSE front
+door; see README "Serving engine").
+
+Importing ``neuronx_distributed_inference_tpu.serving`` keeps exposing the
+adapter surface unchanged (this module used to be ``serving.py``); the
+engine layer is imported explicitly from ``.engine``.
+"""
+
+from .adapter import (ContinuousBatchingAdapter, PagedEngineAdapter,
+                      _EngineAdapterBase)
+
+__all__ = ["ContinuousBatchingAdapter", "PagedEngineAdapter",
+           "_EngineAdapterBase"]

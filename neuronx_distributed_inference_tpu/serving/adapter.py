@@ -105,16 +105,16 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from .modules import autobucketing
-from .modules.block_kv_cache import slots_from_table_into
-from .resilience.errors import (AdmissionError, CapacityError,
+from ..modules import autobucketing
+from ..modules.block_kv_cache import slots_from_table_into
+from ..resilience.errors import (AdmissionError, CapacityError,
                                 ConfigurationError, DeadlineExceeded,
                                 SequenceStateError, ServingError, StepFailure)
-from .resilience.faults import FAULTS as _FAULTS
-from .resilience.preemption import (PREEMPTION_POLICIES, Preempted,
+from ..resilience.faults import FAULTS as _FAULTS
+from ..resilience.preemption import (PREEMPTION_POLICIES, Preempted,
                                     pick_victim)
-from .telemetry import get_registry
-from .telemetry import metrics as tmetrics
+from ..telemetry import get_registry
+from ..telemetry import metrics as tmetrics
 
 
 @dataclass
@@ -127,6 +127,7 @@ class _SeqState:
     admit_idx: int = 0            # adapter-wide admission counter (LIFO)
     deadline: Optional[float] = None   # absolute perf_counter() deadline
     expired_reported: bool = False     # deadline metric counted once
+    meta: Any = None              # opaque engine passthrough (tenant, ...)
 
 
 @dataclass
@@ -142,6 +143,7 @@ class _ChunkState:
     t0: float                     # admission wall time (TTFT anchor)
     deadline: Optional[float] = None
     expired_reported: bool = False
+    meta: Any = None              # opaque engine passthrough (tenant, ...)
 
 
 @dataclass
@@ -159,6 +161,16 @@ class _Inflight:
     out: Dict[str, Any]
     t_dispatch: float
     grown: int = 0                # paged KV tokens grown for this dispatch
+
+
+def _meta_tenant(meta: Any) -> str:
+    """Tenant label value from an opaque per-request ``meta`` payload: the
+    serving engine passes mappings with a "tenant" key; everything else
+    (including the non-engine default None) labels as ""."""
+    try:
+        return str(meta.get("tenant", ""))
+    except AttributeError:
+        return ""
 
 
 def _async_fetch(x):
@@ -188,19 +200,24 @@ class _AdapterTelemetry:
             else get_registry()
 
     def on_add(self, seq_ids: Sequence[int], prompts, t0: float,
-               live: int, padded: int, count_rows: bool = True):
+               live: int, padded: int, count_rows: bool = True,
+               tenants: Optional[Sequence[str]] = None):
         reg = self.registry
         if not reg.enabled:
             return
+        if tenants is None:
+            tenants = [""] * len(seq_ids)
         ttft = time.perf_counter() - t0
         hist = tmetrics.ttft_histogram(reg)
-        for sid, prompt in zip(seq_ids, prompts):
-            span = reg.start_span("request", engine=self.engine, seq_id=sid)
+        for sid, prompt, tenant in zip(seq_ids, prompts, tenants):
+            span = reg.start_span("request", engine=self.engine, seq_id=sid,
+                                  tenant=tenant)
             span.t_start = t0
             span.event("first_token", ttft_s=ttft, prompt_len=len(prompt))
             self._requests[sid] = {"span": span, "steps": 0,
-                                   "t_first": t0 + ttft, "t_last": t0 + ttft}
-            hist.observe(ttft, engine=self.engine)
+                                   "t_first": t0 + ttft, "t_last": t0 + ttft,
+                                   "tenant": tenant}
+            hist.observe(ttft, engine=self.engine, tenant=tenant)
         tmetrics.requests_counter(reg).inc(len(seq_ids), engine=self.engine,
                                            event="added")
         tmetrics.generated_tokens_counter(reg).inc(live, engine=self.engine)
@@ -273,7 +290,7 @@ class _AdapterTelemetry:
                 # inflate its reported per-token latency
                 tmetrics.tpot_histogram(reg).observe(
                     (info["t_last"] - info["t_first"]) / steps,
-                    engine=self.engine)
+                    engine=self.engine, tenant=info.get("tenant", ""))
             span.end()
         if released and reg.enabled:
             tmetrics.requests_counter(reg).inc(released, engine=self.engine,
@@ -1132,7 +1149,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
     def add_requests(self, seq_ids: Sequence[int],
                      prompts: Sequence[Sequence[int]],
                      deadline_s: Union[None, float,
-                                       Sequence[Optional[float]]] = None
+                                       Sequence[Optional[float]]] = None,
+                     meta: Optional[Sequence[Any]] = None
                      ) -> Dict[int, int]:
         """Transactional admission: either every sequence is admitted, or
         every ``begin_sequence`` allocation from this call is rolled back
@@ -1146,8 +1164,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
         ragged rows of shared ctx-bucket dispatches, so any prompt up to
         ``seq_len`` is admissible. With ``prefill_budget_tokens`` set the
         device work is deferred entirely: this call returns ``{}`` and
-        ``step()`` delivers each first token when its final chunk lands."""
-        from .modules.block_kv_cache import cut_cached_at_unwritten
+        ``step()`` delivers each first token when its final chunk lands.
+
+        ``meta`` (optional, one opaque object per sequence) is a scheduler
+        passthrough: the adapter never interprets it beyond reading a
+        "tenant" key for telemetry labels, and hands it back verbatim on
+        :class:`Preempted` records so a requeue needs no side tables."""
+        from ..modules.block_kv_cache import cut_cached_at_unwritten
         _validate_admission(seq_ids, prompts, self.app.tpu_config.seq_len)
         for sid in seq_ids:
             if sid in self.seqs or sid in self._chunks:
@@ -1164,6 +1187,9 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 f"compiled batch of {self.batch}")
         t0 = time.perf_counter()
         deadlines = _resolve_deadlines(deadline_s, len(seq_ids), t0)
+        if meta is not None and len(meta) != len(seq_ids):
+            raise AdmissionError("meta and seq_ids length mismatch")
+        metas = list(meta) if meta is not None else [None] * len(seq_ids)
         app = self.app
         bs = app.kv_mgr.spec.block_size
         protect = frozenset(seq_ids)
@@ -1196,7 +1222,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 self._chunks[sid] = _ChunkState(
                     prompt=prompt, done=int(c),
                     admit_idx=self._admit_counter, t0=t0,
-                    deadline=deadlines[i])
+                    deadline=deadlines[i], meta=metas[i])
         except ServingError:
             self._rollback_admission(begun)
             raise
@@ -1231,7 +1257,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
         # chunk failure must not leave spans/counters for requests that
         # were never admitted
         self.telemetry.on_add(seq_ids, prompts, t0, live=len(seq_ids),
-                              padded=len(seq_ids), count_rows=False)
+                              padded=len(seq_ids), count_rows=False,
+                              tenants=[_meta_tenant(m) for m in metas])
         return {s: self._ready.pop(s) for s in seq_ids}
 
     def release(self, seq_ids: Sequence[int]):
@@ -1333,7 +1360,59 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 retry_safe=app.cache is cache_before) from e
         return toks, pad_to
 
+    # -- scheduler hooks ---------------------------------------------------
+    @property
+    def running_ids(self) -> Tuple[int, ...]:
+        """seq_ids with a decodable row (prefill finished), sorted."""
+        return tuple(sorted(self.seqs))
+
+    @property
+    def pending_prefill_ids(self) -> Tuple[int, ...]:
+        """seq_ids admitted but still mid-prefill (deferred/chunked
+        admissions), in admission order."""
+        return tuple(sorted(self._chunks,
+                            key=lambda s: self._chunks[s].admit_idx))
+
+    @property
+    def free_capacity(self) -> int:
+        """Batch slots an ``add_requests`` call could still admit into
+        (running + pending rows count against the compiled batch)."""
+        return self.batch - len(self.seqs) - len(self._chunks)
+
+    def prefix_warmth(self, prompt: Sequence[int]) -> int:
+        """READ-ONLY probe: how many leading tokens of ``prompt`` an
+        admission right now would serve from the prefix cache. Peeks the
+        :class:`~..modules.block_kv_cache.BlockKVCacheManager` hash state
+        without taking references or touching LRU order, and cuts the
+        count at the first block whose writer has not landed yet (pending
+        chunked admissions) — exactly the cut a real admission would
+        apply. Schedulers use it to order admission batches warm-first;
+        capped at ``len(prompt) - 1`` like admission itself (the final
+        token always runs to produce the first sample)."""
+        from ..modules.block_kv_cache import cut_cached_at_unwritten
+        cached, blocks = self.app.kv_mgr.probe_cached_tokens(prompt)
+        if cached and self._unwritten:
+            cached = cut_cached_at_unwritten(
+                blocks, cached, self.app.kv_mgr.spec.block_size,
+                self._unwritten)
+        return min(cached, len(prompt) - 1)
+
     # -- preemption -------------------------------------------------------
+    def preempt(self, seq_id: int, reason: str = "scheduler") -> Preempted:
+        """Scheduler-driven eviction of one running or pending sequence:
+        its blocks are reclaimed (never-written blocks invalidated, not
+        freed as servable) and the :class:`Preempted` record — tokens so
+        far, remaining deadline, meta passthrough — is returned AND queued
+        for :meth:`take_preempted`. A pipelined in-flight token for the
+        victim is dropped (the requeue replay regenerates it, same as
+        pressure preemption). Raises :class:`SequenceStateError` for an
+        unknown/released seq_id."""
+        if seq_id not in self.seqs and seq_id not in self._chunks:
+            raise SequenceStateError(
+                f"cannot preempt seq_id {seq_id}: not running or pending")
+        self._preempt(seq_id, reason)
+        return self.preempted[-1]
+
     def take_preempted(self) -> List[Preempted]:
         """Drain :class:`Preempted` records accumulated since the last
         call. The engine re-queues each ``record.tokens`` as a new prompt;
@@ -1366,7 +1445,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
             self._abort_pending(victim)
             self.preempted.append(Preempted(
                 seq_id=victim, tokens=tuple(cst.prompt),
-                prompt_len=len(cst.prompt), n_generated=0, reason=reason))
+                prompt_len=len(cst.prompt), n_generated=0, reason=reason,
+                deadline=cst.deadline, meta=cst.meta))
             self.telemetry.on_preempt(victim, reason)
             return
         st = self.seqs.pop(victim)
@@ -1376,7 +1456,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self.preempted.append(Preempted(
             seq_id=victim, tokens=tuple(st.tokens),
             prompt_len=st.prompt_len,
-            n_generated=len(st.tokens) - st.prompt_len, reason=reason))
+            n_generated=len(st.tokens) - st.prompt_len, reason=reason,
+            deadline=st.deadline, meta=st.meta))
         self.telemetry.on_preempt(victim, reason)
 
     def _grow_with_preemption(self, live: Sequence[int],
@@ -1549,19 +1630,20 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 position=len(st.prompt), last_token=tok,
                 tokens=list(st.prompt) + [tok],
                 prompt_len=len(st.prompt), admit_idx=st.admit_idx,
-                deadline=st.deadline)
+                deadline=st.deadline, meta=st.meta)
             self._scratch = None   # live set grew; see add_requests note
             self._ready[s] = tok
             if not defer_telemetry:
                 self.telemetry.on_add([s], [st.prompt], st.t0, live=1,
-                                      padded=1, count_rows=False)
+                                      padded=1, count_rows=False,
+                                      tenants=[_meta_tenant(st.meta)])
 
     def _pack_prefill_rows(self, rows):
         """Build the ragged packed-chunk inputs: one row per sequence,
         positions at each row's own suffix offset, slots through its own
         block table; width = smallest ctx bucket covering the longest
         chunk, batch padded by repeating row 0 (the usual invariant)."""
-        from .modules.block_kv_cache import slots_from_table
+        from ..modules.block_kv_cache import slots_from_table
         app = self.app
         b = len(rows)
         width = autobucketing.get_target_bucket(

@@ -1,0 +1,13 @@
+"""Multi-tenant serving engine: request queue with priority classes and
+weighted-fair tenant scheduling, prefix-cache-aware admission ordering,
+per-request token streams, and an asyncio HTTP/SSE front door — the
+closed-loop layer a load balancer talks to, over the paged adapter
+(ROADMAP item 3; README "Serving engine" is the contract)."""
+
+from .frontend import ServingFrontend
+from .queue import MultiTenantQueue, QueuedRequest
+from .scheduler import ServingEngine
+from .streams import TokenStream
+
+__all__ = ["ServingEngine", "ServingFrontend", "TokenStream",
+           "MultiTenantQueue", "QueuedRequest"]

@@ -1,0 +1,276 @@
+"""Asyncio HTTP/SSE front door for the serving engine — stdlib only.
+
+A deliberately small HTTP/1.1 server (``asyncio.start_server``; no
+framework dependencies, mirroring the repo-wide no-deps rule) exposing the
+engine's submit / stream / cancel / metrics surface:
+
+  ``POST /v1/generate``
+      Body: ``{"prompt": [ints], "max_new_tokens": n, "tenant": "...",
+      "priority": 0, "deadline_s": null, "stop_tokens": [],
+      "stream": true}``. With ``"stream": true`` (default) the response is
+      ``text/event-stream``: one ``data: {"token": t, "index": i}`` event
+      per token, then a terminal
+      ``data: {"done": true, "reason": "...", "request_id": "..."}``
+      event. With ``"stream": false`` the connection blocks and returns
+      one JSON body with the full token list.
+  ``POST /v1/submit``
+      Same body (sans "stream"); returns ``{"request_id": ...}``
+      immediately. Attach later via ``GET /v1/stream/<id>``.
+  ``GET /v1/stream/<id>``
+      SSE attach to a submitted request (replays from token 0, then
+      follows live).
+  ``POST /v1/cancel/<id>``
+      Returns ``{"cancelled": bool}``. Cancelling a queued request costs
+      no device work; a running one is released and its blocks reclaimed.
+  ``GET /metrics``
+      Prometheus text exposition of the process-global registry.
+  ``GET /healthz``
+      ``{"ok": true, "queue_depth": n, "running": m}``.
+
+Client-gone behaviour: when an SSE write fails (peer reset / closed), the
+front end cancels the request through the engine — blocks are reclaimed
+and the stream finishes "cancelled" — so a dead client can never pin KV.
+
+Errors map onto the typed taxonomy: QueueOverflow -> 429,
+AdmissionError -> 400, unknown ids -> 404, closed engine -> 503.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from ...resilience.errors import AdmissionError, QueueOverflow, ServingError
+from ...telemetry import get_registry
+from .scheduler import ServingEngine
+from .streams import TokenStream
+
+__all__ = ["ServingFrontend"]
+
+_MAX_BODY = 1 << 20                      # 1 MiB request-body cap
+
+
+class _HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                405: "Method Not Allowed", 413: "Payload Too Large",
+                429: "Too Many Requests", 500: "Internal Server Error",
+                503: "Service Unavailable"}
+
+
+class ServingFrontend:
+    """Owns the listener socket, the engine's ``run_forever`` task, and
+    the per-connection request handlers."""
+
+    def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._engine_task: Optional[asyncio.Task] = None
+        self._streams: Dict[str, TokenStream] = {}   # submitted via HTTP
+
+    async def start(self) -> Tuple[str, int]:
+        """Bind, start serving connections and the engine loop; returns
+        the bound (host, port) — port 0 resolves to an ephemeral one."""
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._engine_task = asyncio.ensure_future(self.engine.run_forever())
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        """Close the listener, stop the engine loop (cancelling all
+        outstanding requests), and wait for both to wind down."""
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        self.engine.close()
+        if self._engine_task is not None:
+            await self._engine_task
+        self._streams.clear()
+
+    # -- connection handling ----------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                method, path, body = await self._read_request(reader)
+                await self._route(method, path, body, writer)
+            except _HttpError as e:
+                await self._send_json(writer, e.status,
+                                      {"error": str(e)})
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass                      # client went away mid-exchange
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _read_request(self, reader: asyncio.StreamReader):
+        line = await reader.readline()
+        parts = line.decode("latin-1").split()
+        if len(parts) < 2:
+            raise _HttpError(400, "malformed request line")
+        method, path = parts[0].upper(), parts[1]
+        length = 0
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = hline.decode("latin-1").partition(":")
+            if name.strip().lower() == "content-length":
+                try:
+                    length = int(value.strip())
+                except ValueError:
+                    raise _HttpError(400, "bad Content-Length")
+                if length < 0:
+                    raise _HttpError(400, "bad Content-Length")
+        if length > _MAX_BODY:
+            raise _HttpError(413, "request body too large")
+        body = await reader.readexactly(length) if length else b""
+        return method, path, body
+
+    async def _route(self, method: str, path: str, body: bytes,
+                     writer: asyncio.StreamWriter) -> None:
+        if path == "/healthz" and method == "GET":
+            await self._send_json(writer, 200, {
+                "ok": not self.engine._closed,
+                "queue_depth": self.engine.queue.depth,
+                "running": len(self.engine._active)})
+        elif path == "/metrics" and method == "GET":
+            text = get_registry().render_prometheus()
+            await self._send_raw(writer, 200, text.encode(),
+                                 "text/plain; version=0.0.4")
+        elif path == "/v1/generate" and method == "POST":
+            spec = self._parse_spec(body)
+            stream = self._submit(spec)
+            if spec.get("stream", True):
+                await self._sse(writer, stream)
+            else:
+                # consume while waiting (not wait_finished + .tokens):
+                # under max_unread_tokens backpressure an unconsumed
+                # stream would stall its own decode forever
+                toks = [tok async for tok in stream]
+                await self._send_json(writer, 200, {
+                    "request_id": stream.request_id,
+                    "tokens": toks, "reason": stream.finish_reason})
+        elif path == "/v1/submit" and method == "POST":
+            stream = self._submit(self._parse_spec(body))
+            self._prune_streams()
+            self._streams[stream.request_id] = stream
+            await self._send_json(writer, 200,
+                                  {"request_id": stream.request_id})
+        elif path.startswith("/v1/stream/") and method == "GET":
+            stream = self._streams.get(path[len("/v1/stream/"):])
+            if stream is None:
+                raise _HttpError(404, "unknown request id")
+            await self._sse(writer, stream, replay=True)
+        elif path.startswith("/v1/cancel/") and method == "POST":
+            rid = path[len("/v1/cancel/"):]
+            await self._send_json(writer, 200,
+                                  {"cancelled": self.engine.cancel(rid)})
+        else:
+            raise _HttpError(404 if method in ("GET", "POST") else 405,
+                             f"no route for {method} {path}")
+
+    # -- engine glue -------------------------------------------------------
+    _MAX_RETAINED_STREAMS = 256
+
+    def _prune_streams(self) -> None:
+        """Bound the /v1/submit registry: drop the oldest FINISHED streams
+        beyond the cap (dict preserves insertion order), so a long-lived
+        server does not retain one token list per request forever.
+        Unfinished streams are never dropped — their requests are live."""
+        excess = len(self._streams) - self._MAX_RETAINED_STREAMS + 1
+        if excess <= 0:
+            return
+        for rid in [r for r, s in self._streams.items()
+                    if s.finished][:excess]:
+            del self._streams[rid]
+
+    def _parse_spec(self, body: bytes) -> Dict[str, Any]:
+        try:
+            spec = json.loads(body.decode() or "{}")
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise _HttpError(400, f"bad JSON body: {e}")
+        if not isinstance(spec, dict):
+            raise _HttpError(400, "body must be a JSON object")
+        return spec
+
+    def _submit(self, spec: Dict[str, Any]) -> TokenStream:
+        try:
+            return self.engine.submit(
+                spec.get("prompt", ()),
+                int(spec.get("max_new_tokens", 16)),
+                tenant=str(spec.get("tenant", "default")),
+                priority=int(spec.get("priority", 0)),
+                deadline_s=spec.get("deadline_s"),
+                stop_tokens=spec.get("stop_tokens", ()),
+                request_id=spec.get("request_id"))
+        except QueueOverflow as e:
+            raise _HttpError(429, str(e))
+        except AdmissionError as e:
+            raise _HttpError(400, str(e))
+        except (TypeError, ValueError) as e:
+            raise _HttpError(400, f"bad request spec: {e}")
+        except ServingError as e:
+            raise _HttpError(503, str(e))
+
+    # -- wire formats ------------------------------------------------------
+    async def _sse(self, writer: asyncio.StreamWriter, stream: TokenStream,
+                   replay: bool = False) -> None:
+        """Server-sent events: data-only JSON events, one per token, then
+        one terminal done event. A failed write cancels the request."""
+        head = (b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-store\r\n"
+                b"Connection: close\r\n\r\n")
+        writer.write(head)
+        try:
+            await writer.drain()
+            idx = 0
+            # replay attaches iterate a PRIVATE cursor from token 0, so
+            # concurrent consumers of one stream each see the full stream
+            source = stream.iter_from(0) if replay else stream
+            async for tok in source:
+                writer.write(self._sse_event(
+                    {"token": tok, "index": idx}))
+                idx += 1
+                await writer.drain()
+            done: Dict[str, Any] = {"done": True,
+                                    "reason": stream.finish_reason,
+                                    "request_id": stream.request_id}
+            if stream.error is not None:
+                done["error"] = str(stream.error)
+            writer.write(self._sse_event(done))
+            await writer.drain()
+        except (ConnectionError, OSError):
+            # client is gone: reclaim the sequence's blocks
+            self.engine.cancel(stream.request_id)
+
+    @staticmethod
+    def _sse_event(payload: Dict[str, Any]) -> bytes:
+        return b"data: " + json.dumps(payload).encode() + b"\n\n"
+
+    async def _send_json(self, writer: asyncio.StreamWriter, status: int,
+                         payload: Dict[str, Any]) -> None:
+        await self._send_raw(writer, status, json.dumps(payload).encode(),
+                             "application/json")
+
+    async def _send_raw(self, writer: asyncio.StreamWriter, status: int,
+                        body: bytes, ctype: str) -> None:
+        writer.write(
+            f"HTTP/1.1 {status} {_STATUS_TEXT.get(status, '')}\r\n"
+            f"Content-Type: {ctype}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n\r\n".encode() + body)
+        await writer.drain()

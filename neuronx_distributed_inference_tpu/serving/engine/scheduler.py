@@ -1,0 +1,524 @@
+"""The closed-loop multi-tenant serving engine over the paged adapter.
+
+:class:`ServingEngine` composes every serving primitive PRs 1-5 landed —
+typed transactional admission, recompute preemption, per-request deadlines,
+prefix caching with unwritten-block tracking, chunked prefill under
+``prefill_budget_tokens``, pipelined ``step_many``/``flush``, and the
+telemetry contract — into the engine a load balancer talks to
+(ROADMAP item 3; external yardstick: the Gemma-on-Cloud-TPU serving stack,
+PAPERS.md arxiv 2605.25645, which reports TTFT/TPOT p50/p99 under
+concurrent multi-tenant load).
+
+One :meth:`ServingEngine.run_pass` is the whole closed loop:
+
+  1. **expire** queued requests past their deadline (typed, zero device
+     work) and collect adapter preemption records into front-of-queue
+     requeues (the :class:`~...resilience.Preempted` ``requeue`` payload —
+     tokens, remaining deadline, tenant/priority meta — re-admits without
+     side tables; greedy replay is bit-identical, pinned);
+  2. **preempt** for priority: when the batch is full and a strictly
+     higher-priority request is queued, evict the lowest-priority (then
+     most recently admitted) victim via the adapter's public
+     :meth:`~..adapter.PagedEngineAdapter.preempt` hook;
+  3. **admit** up to ``free_capacity`` requests picked by the queue's
+     weighted-fair/priority/starvation-bound order, sorted warm-prefix
+     first (:meth:`~..adapter.PagedEngineAdapter.prefix_warmth` peeks the
+     block-hash state read-only), as ONE transactional ``add_requests``
+     call — chunked prefill under the adapter's budget knob keeps a long
+     admission from stalling running decodes;
+  4. **dispatch** one decode horizon (``step``/``step_many``) for every
+     eligible running row — skipping consumers over their backpressure
+     bound — and route tokens to per-request streams.
+
+The engine is synchronous at its core (drive it with :meth:`run_pass` /
+:meth:`run_until_drained` from tests and benches); :meth:`run_forever` is
+the asyncio wrapper the SSE front door uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ...resilience.errors import (AdmissionError, CapacityError,
+                                  ConfigurationError, DeadlineExceeded,
+                                  ServingError, StepFailure)
+from ...telemetry import get_registry
+from ...telemetry import metrics as tmetrics
+from .queue import MultiTenantQueue, QueuedRequest
+from .streams import TokenStream
+
+__all__ = ["ServingEngine"]
+
+
+class ServingEngine:
+    """Multi-tenant scheduler + streaming front door over a
+    :class:`~..adapter.PagedEngineAdapter`.
+
+    ``tenant_weights`` maps tenant name -> weight (unlisted tenants get
+    ``default_weight``); running-slot shares converge to the weight ratios
+    under backlog (see ``queue.py`` for the full fairness contract).
+    ``decode_steps_per_pass > 1`` fuses that many decode steps per pass
+    through ``step_many`` (one dispatch + one fetch), clamped so no row
+    can overshoot its token budget or the compiled ``seq_len``.
+    ``max_unread_tokens`` bounds how far a stream may run ahead of its
+    consumer before the engine stops stepping that sequence (None = no
+    backpressure). ``priority_preemption=False`` disables scheduler-driven
+    eviction (the adapter's own KV-pressure preemption still applies)."""
+
+    def __init__(self, adapter, *,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0,
+                 max_queue_depth: Optional[int] = 256,
+                 starvation_bound_s: float = 2.0,
+                 max_unread_tokens: Optional[int] = None,
+                 decode_steps_per_pass: int = 1,
+                 priority_preemption: bool = True):
+        for hook in ("take_preempted", "preempt", "prefix_warmth",
+                     "free_capacity", "pending_prefill_ids"):
+            if not hasattr(adapter, hook):
+                raise ConfigurationError(
+                    "ServingEngine needs the paged adapter surface "
+                    f"(missing {hook!r}); build it over a "
+                    "PagedEngineAdapter")
+        if decode_steps_per_pass < 1:
+            raise ConfigurationError("decode_steps_per_pass must be >= 1")
+        self.adapter = adapter
+        self.queue = MultiTenantQueue(tenant_weights, default_weight,
+                                      max_queue_depth, starvation_bound_s)
+        self.decode_steps_per_pass = decode_steps_per_pass
+        self.max_unread_tokens = max_unread_tokens
+        self.priority_preemption = priority_preemption
+        self._active: Dict[int, QueuedRequest] = {}     # seq_id -> request
+        self._sid_of: Dict[str, int] = {}               # request_id -> seq
+        self._seq_ids = itertools.count()
+        self._rid_counter = itertools.count()
+        self._reserved: List[str] = []   # rids owed the next freed slots
+        self._closed = False
+        try:
+            self._max_prompt = adapter.app.tpu_config.seq_len
+        except AttributeError:
+            self._max_prompt = None
+        self.stats: Dict[str, int] = {
+            "submitted": 0, "completed": 0, "expired_queue": 0,
+            "expired_running": 0, "cancelled": 0, "preempt_requeues": 0,
+            "priority_preemptions": 0, "admission_retries": 0,
+            "capacity_stalls": 0, "step_retries": 0}
+
+    # -- public surface ----------------------------------------------------
+    def submit(self, tokens: Sequence[int], max_new_tokens: int, *,
+               tenant: str = "default", priority: int = 0,
+               deadline_s: Optional[float] = None,
+               stop_tokens: Sequence[int] = (),
+               request_id: Optional[str] = None) -> TokenStream:
+        """Enqueue one request; returns its :class:`TokenStream`
+        immediately (no device work happens here). Raises the typed
+        :class:`~...resilience.errors.QueueOverflow` when the queue is at
+        ``max_queue_depth`` and :class:`AdmissionError` for malformed
+        arguments — both before any state change."""
+        if self._closed:
+            raise ServingError("engine is closed")
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise AdmissionError("empty prompt")
+        if self._max_prompt is not None and len(tokens) > self._max_prompt:
+            # reject here, not at admission time: by then the request is
+            # batched with innocent neighbours inside one transactional
+            # add_requests call
+            raise AdmissionError(
+                f"prompt is {len(tokens)} tokens — beyond the compiled "
+                f"seq_len {self._max_prompt}")
+        if max_new_tokens < 1:
+            raise AdmissionError("max_new_tokens must be >= 1")
+        rid = (request_id if request_id is not None
+               else f"r{next(self._rid_counter)}")
+        if rid in self._sid_of or any(
+                r.request_id == rid for r in self._queued()):
+            raise AdmissionError(f"request_id {rid!r} already in flight")
+        now = time.perf_counter()
+        stream = TokenStream(rid, tenant)
+        req = QueuedRequest(
+            request_id=rid, tokens=tokens, max_new_tokens=max_new_tokens,
+            tenant=tenant, priority=priority,
+            deadline=None if deadline_s is None else now + deadline_s,
+            enqueue_t=now, order=self.queue.next_order(), stream=stream,
+            orig_prompt_len=len(tokens),
+            stop_tokens=frozenset(int(t) for t in stop_tokens),
+            meta={"request_id": rid, "tenant": tenant,
+                  "priority": priority})
+        self.queue.push(req)         # may raise QueueOverflow
+        stream._cancel_cb = lambda: self.cancel(rid)
+        self.stats["submitted"] += 1
+        return req.stream
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a queued or running request: queued entries are dropped
+        with zero device work; running sequences are released and their
+        KV blocks reclaimed. Returns False when the id is unknown or
+        already finished."""
+        req = self.queue.remove(request_id)
+        if req is not None:
+            self._observe_wait(req, "cancelled")
+            req.stream.finish("cancelled", req.stream.cancelled_error())
+            self.stats["cancelled"] += 1
+            return True
+        sid = self._sid_of.get(request_id)
+        if sid is None:
+            return False
+        req = self._retire(sid)
+        self.adapter.release([sid])
+        req.stream.finish("cancelled", req.stream.cancelled_error())
+        self.stats["cancelled"] += 1
+        return True
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active) or self.queue.depth > 0
+
+    def run_pass(self) -> int:
+        """One closed-loop scheduling pass (see the module docstring).
+        Returns the number of tokens delivered to streams."""
+        now = time.perf_counter()
+        self._expire_queue(now)
+        self._collect_preempted()
+        self._priority_preempt()
+        self._admit(now)
+        # admission may itself have preempted running victims for blocks
+        # (reason="admission"): requeue them before the dispatch stage so
+        # their dead seq_ids never reach a step call
+        self._collect_preempted()
+        return self._dispatch_engine_pass()
+
+    def run_until_drained(self, max_passes: int = 100000) -> None:
+        """Drive :meth:`run_pass` until no queued or running work remains
+        (closed-loop tests and benches). Raises :class:`StepFailure` if
+        the device dies unrecoverably mid-drive."""
+        passes = 0
+        while self.has_work:
+            self.run_pass()
+            passes += 1
+            if passes >= max_passes:
+                raise ServingError(
+                    f"run_until_drained made no progress in {max_passes} "
+                    "passes — scheduler wedged (file a bug with the "
+                    "engine stats)", seq_ids=tuple(self._active))
+
+    async def run_forever(self, idle_sleep_s: float = 0.001) -> None:
+        """Asyncio driver: run scheduling passes until :meth:`close`,
+        yielding to the event loop between passes (and napping while
+        idle) so SSE writers and new submits interleave."""
+        while not self._closed:
+            delivered = self.run_pass() if self.has_work else 0
+            if delivered or self.has_work:
+                await asyncio.sleep(0)
+            else:
+                await asyncio.sleep(idle_sleep_s)
+
+    def close(self) -> None:
+        """Stop :meth:`run_forever` and fail over remaining work: queued
+        and running requests finish with reason "cancelled"."""
+        self._closed = True
+        for req in list(self._queued()):
+            self.queue.remove(req.request_id)
+            req.stream.finish("cancelled", req.stream.cancelled_error())
+        for sid in list(self._active):
+            req = self._retire(sid)
+            self.adapter.release([sid])
+            req.stream.finish("cancelled", req.stream.cancelled_error())
+
+    # -- pass stages -------------------------------------------------------
+    def _expire_queue(self, now: float) -> None:
+        for req in self.queue.expire(now):
+            self._observe_wait(req, "expired")
+            reg = get_registry()
+            if reg.enabled:
+                tmetrics.deadline_expired_counter(reg).inc(engine="queue")
+            req.stream.finish("deadline", DeadlineExceeded(
+                f"request {req.request_id} expired after "
+                f"{now - req.enqueue_t:.3f}s in queue"))
+            self.stats["expired_queue"] += 1
+
+    def _collect_preempted(self) -> None:
+        for rec in self.adapter.take_preempted():
+            self._requeue(rec)
+
+    def _requeue(self, rec) -> None:
+        """Turn one :class:`Preempted` record back into a queued request
+        via its requeue payload. Tokens the victim generated before
+        eviction are part of the recompute prompt — any not yet delivered
+        (sampled while in flight) are delivered now, and the budget
+        counts them."""
+        meta = rec.meta or {}
+        rid = meta.get("request_id")
+        req = self._active.get(rec.seq_id)
+        if req is None or rid != req.request_id:
+            return                   # not engine-owned (foreign caller)
+        del self._active[rec.seq_id]
+        del self._sid_of[rid]
+        generated = list(rec.tokens[req.orig_prompt_len:])
+        already = req.stream.n_tokens
+        done = False
+        for tok in generated[already:]:
+            req.stream.put(tok)
+            done = self._hit_limit(req, tok)
+            if done:
+                break
+        if done:
+            self.stats["completed"] += 1
+            return
+        req.tokens = list(rec.tokens)
+        req.deadline = rec.deadline
+        req.n_preemptions += 1
+        self.queue.push(req, front=True)
+        self.stats["preempt_requeues"] += 1
+
+    def _priority_preempt(self) -> None:
+        """When the batch is full and a strictly higher-priority request
+        waits, evict the lowest-priority victim (ties: most recently
+        submitted) through the adapter hook and requeue it at the front
+        of its tenant's lane. The freed slot is RESERVED for the request
+        that justified the eviction — without the reservation, weighted
+        fairness could hand the slot straight back to the victim and
+        livelock in an evict/re-prefill cycle while the high-priority
+        request starves."""
+        if not self.priority_preemption:
+            return
+        while self.adapter.free_capacity == 0 and self._active:
+            best = max(self._queued(),
+                       key=lambda r: (r.priority, -r.order), default=None)
+            if best is None:
+                return
+            victim_sid, victim = min(
+                self._active.items(),
+                key=lambda kv: (kv[1].priority, -kv[1].order))
+            if victim.priority >= best.priority:
+                return               # nothing strictly lower-priority
+            rec = self.adapter.preempt(victim_sid, reason="scheduler")
+            self.stats["priority_preemptions"] += 1
+            self._requeue(rec)
+            self._reserved.append(best.request_id)
+
+    def _admit(self, now: float) -> None:
+        cap = self.adapter.free_capacity
+        if cap <= 0 or self.queue.depth == 0:
+            self._reserved.clear()
+            return
+        # slots freed by priority preemption go to the requests that
+        # justified the evictions, ahead of the weighted-fair pick
+        batch: List[QueuedRequest] = []
+        for rid in self._reserved:
+            if len(batch) >= cap:
+                break
+            req = self.queue.remove(rid)   # None: cancelled/expired since
+            if req is not None:
+                batch.append(req)
+        self._reserved.clear()
+        if len(batch) < cap:
+            occupied: Dict[str, int] = {}
+            for req in self._active.values():
+                occupied[req.tenant] = occupied.get(req.tenant, 0) + 1
+            for req in batch:
+                occupied[req.tenant] = occupied.get(req.tenant, 0) + 1
+            batch.extend(self.queue.pop_batch(cap - len(batch), occupied,
+                                              now))
+        if not batch:
+            return
+        # warm-prefix-first admission ordering: stable sort keeps the
+        # fairness pick order among equally-warm requests, and puts warm
+        # prompts ahead so intra-call shared prefixes hit originator-first
+        batch.sort(key=lambda r: -self.adapter.prefix_warmth(r.tokens))
+        try:
+            first = self._add_batch(batch, now)
+        except DeadlineExceeded:
+            # a zero-remaining budget expired inside admission: retry the
+            # expiry stage next pass (adapter rolled the call back)
+            for r in reversed(batch):
+                self.queue.push(r, front=True)
+            self.stats["admission_retries"] += 1
+            return
+        except AdmissionError:
+            # one bad request must not sink its innocent batch neighbours
+            # (or the serving loop): isolate it by admitting one-by-one
+            first = {}
+            for r in batch:
+                try:
+                    first.update(self._add_batch([r], now))
+                except AdmissionError as e:
+                    r.stream.finish("error", e)
+                except (DeadlineExceeded, CapacityError, StepFailure) as e:
+                    if isinstance(e, StepFailure) and not e.retry_safe:
+                        self._fatal(e)
+                        raise
+                    self.queue.push(r, front=True)
+                    self.stats["admission_retries"] += 1
+        except (CapacityError, StepFailure) as e:
+            if isinstance(e, StepFailure) and not e.retry_safe:
+                self._fatal(e)
+                raise
+            # pool dry even after the adapter's own eviction, or a
+            # retry-safe fault: requeue and try again next pass
+            for r in reversed(batch):
+                self.queue.push(r, front=True)
+            self.stats["admission_retries"] += 1
+            return
+        for sid, tok in first.items():   # non-deferred adapters
+            self._deliver(sid, [tok])
+
+    def _add_batch(self, batch: List[QueuedRequest],
+                   now: float) -> Dict[int, int]:
+        """One transactional add_requests call; registers the admitted
+        requests and returns the adapter's first-token dict (empty under
+        a deferred prefill budget)."""
+        sids = [next(self._seq_ids) for _ in batch]
+        first = self.adapter.add_requests(
+            sids, [r.tokens for r in batch],
+            deadline_s=[None if r.deadline is None
+                        else max(r.deadline - now, 0.0) for r in batch],
+            meta=[r.meta for r in batch])
+        for sid, req in zip(sids, batch):
+            self._active[sid] = req
+            self._sid_of[req.request_id] = sid
+            self._observe_wait(req, "admitted")
+        return first
+
+    def _dispatch_engine_pass(self) -> int:
+        """Drive one decode horizon and route tokens to streams. This is
+        the engine's dispatch-driving loop: it must stay free of host
+        materialization of device values (tier-1 lint region,
+        ``scripts/check_host_sync.py``) — every token it touches is
+        already a host int handed back by the adapter."""
+        pending = set(self.adapter.pending_prefill_ids)
+        alive = self.adapter.seqs
+        eligible: List[int] = []
+        horizon = self.decode_steps_per_pass
+        for sid, req in self._active.items():
+            if sid not in alive and sid not in pending:
+                continue             # preempted, record not collected yet
+            if sid in pending:
+                eligible.append(sid)   # wants prefill progress, no decode
+                continue
+            if (self.max_unread_tokens is not None
+                    and req.stream.unread >= self.max_unread_tokens):
+                continue               # backpressure: consumer is behind
+            horizon = min(horizon, self._room(sid, req))
+            eligible.append(sid)
+        if not eligible:
+            drained = self.adapter.flush()   # pipelined leftovers
+            return self._route(drained if isinstance(drained, dict) else {})
+        try:
+            if horizon > 1:
+                res = self.adapter.step_many(horizon, eligible)
+            else:
+                res = {s: [t] for s, t in
+                       self.adapter.step(eligible).items()}
+        except DeadlineExceeded as e:
+            self._expire_running(e.seq_ids)
+            return 0
+        except CapacityError as e:
+            if e.seq_ids:
+                self._finish_capacity(e.seq_ids)
+            else:
+                self.stats["capacity_stalls"] += 1
+            return 0
+        except StepFailure as e:
+            if e.retry_safe:
+                self.stats["step_retries"] += 1
+                return 0
+            self._fatal(e)
+            raise
+        return self._route(res)
+
+    # -- token routing -----------------------------------------------------
+    def _route(self, res) -> int:
+        n = 0
+        for sid, toks in res.items():
+            toks = toks if isinstance(toks, list) else [toks]
+            n += self._deliver(sid, toks)
+        return n
+
+    def _deliver(self, sid: int, toks: List[int]) -> int:
+        req = self._active.get(sid)
+        if req is None:
+            return 0                 # raced with cancel/preempt
+        n = 0
+        for tok in toks:
+            req.stream.put(tok)
+            n += 1
+            if self._hit_limit(req, tok):
+                self._retire(sid)
+                self.adapter.release([sid])
+                self.stats["completed"] += 1
+                break
+        return n
+
+    def _hit_limit(self, req: QueuedRequest, tok: int) -> bool:
+        if tok in req.stop_tokens:
+            req.stream.finish("stop")
+            return True
+        if req.stream.n_tokens >= req.max_new_tokens:
+            req.stream.finish("length")
+            return True
+        return False
+
+    def _room(self, sid: int, req: QueuedRequest) -> int:
+        """Largest decode horizon this row can take without overshooting
+        its token budget or the compiled seq_len."""
+        room = req.max_new_tokens - req.stream.n_tokens
+        st = self.adapter.seqs.get(sid)
+        limit = getattr(self.adapter, "_pos_limit", None)
+        if st is not None and limit is not None:
+            room = min(room, limit - st.position)
+        return max(room, 1)
+
+    # -- terminal paths ----------------------------------------------------
+    def _retire(self, sid: int) -> QueuedRequest:
+        req = self._active.pop(sid)
+        self._sid_of.pop(req.request_id, None)
+        return req
+
+    def _expire_running(self, seq_ids: Sequence[int]) -> None:
+        for sid in seq_ids:
+            if sid not in self._active:
+                continue
+            req = self._retire(sid)
+            self.adapter.release([sid])
+            req.stream.finish("deadline", DeadlineExceeded(
+                f"request {req.request_id} exceeded its deadline while "
+                "running"))
+            self.stats["expired_running"] += 1
+
+    def _finish_capacity(self, seq_ids: Sequence[int]) -> None:
+        for sid in seq_ids:
+            if sid not in self._active:
+                continue
+            req = self._retire(sid)
+            self.adapter.release([sid])
+            req.stream.finish("capacity", CapacityError(
+                f"request {req.request_id} reached the compiled seq_len",
+                seq_ids=(sid,)))
+
+    def _fatal(self, err: StepFailure) -> None:
+        """Unrecoverable device failure: every stream is failed; the
+        adapter (and its application) must be rebuilt before serving."""
+        self._closed = True
+        for sid in list(self._active):
+            req = self._retire(sid)
+            req.stream.finish("error", err)
+        for req in list(self._queued()):
+            self.queue.remove(req.request_id)
+            req.stream.finish("error", err)
+
+    # -- helpers -----------------------------------------------------------
+    def _queued(self):
+        for heap in self.queue._heaps.values():
+            for _, req in heap:
+                yield req
+
+    def _observe_wait(self, req: QueuedRequest, outcome: str) -> None:
+        reg = get_registry()
+        if reg.enabled:
+            tmetrics.queue_wait_histogram(reg).observe(
+                time.perf_counter() - req.enqueue_t,
+                tenant=req.tenant, outcome=outcome)

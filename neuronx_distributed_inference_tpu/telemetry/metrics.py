@@ -24,6 +24,10 @@ REQUESTS_TOTAL = "nxdi_requests_total"                # event=added|released
 PREFILL_CHUNKS_TOTAL = "nxdi_prefill_chunks_total"      # engine
 PREFILL_PAD_WASTE = "nxdi_prefill_pad_waste"            # engine
 
+# -- serving engine (serving/engine/) ----------------------------------------
+QUEUE_DEPTH = "nxdi_queue_depth"                        # tenant
+QUEUE_WAIT_SECONDS = "nxdi_queue_wait_seconds"          # tenant, outcome
+
 # -- decode pipeline (serving.py) --------------------------------------------
 DISPATCH_DEPTH = "nxdi_dispatch_depth"                  # engine
 HOST_OVERLAP_SECONDS = "nxdi_host_overlap_seconds"      # engine
@@ -58,10 +62,12 @@ MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
 
 
 def ttft_histogram(reg):
+    # tenant label: "" outside the multi-tenant serving engine (additive —
+    # single-tenant dashboards aggregate over it unchanged)
     return reg.histogram(
         REQUEST_TTFT_SECONDS,
         "Time from request admission to its first generated token (s)",
-        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+        labels=("engine", "tenant"), buckets=DEFAULT_LATENCY_BUCKETS)
 
 
 def decode_step_histogram(reg):
@@ -75,7 +81,22 @@ def tpot_histogram(reg):
     return reg.histogram(
         REQUEST_TPOT_SECONDS,
         "Per-request mean time-per-output-token after the first token (s)",
-        labels=("engine",), buckets=DEFAULT_LATENCY_BUCKETS)
+        labels=("engine", "tenant"), buckets=DEFAULT_LATENCY_BUCKETS)
+
+
+def queue_depth_gauge(reg):
+    return reg.gauge(
+        QUEUE_DEPTH,
+        "Requests waiting in the serving engine's admission queue",
+        labels=("tenant",))
+
+
+def queue_wait_histogram(reg):
+    return reg.histogram(
+        QUEUE_WAIT_SECONDS,
+        "Time a request spent queued before admission "
+        "(outcome=admitted|expired|cancelled)",
+        labels=("tenant", "outcome"), buckets=DEFAULT_LATENCY_BUCKETS)
 
 
 def live_batch_gauge(reg):

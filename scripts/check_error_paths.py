@@ -29,7 +29,11 @@ BANNED = ("ValueError", "RuntimeError")
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = (
-    "neuronx_distributed_inference_tpu/serving.py",
+    "neuronx_distributed_inference_tpu/serving/adapter.py",
+    "neuronx_distributed_inference_tpu/serving/engine/queue.py",
+    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
+    "neuronx_distributed_inference_tpu/serving/engine/streams.py",
+    "neuronx_distributed_inference_tpu/serving/engine/frontend.py",
     "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
 )
 

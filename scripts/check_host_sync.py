@@ -49,14 +49,18 @@ REGION_PREFIX = "_dispatch"
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = (
-    "neuronx_distributed_inference_tpu/serving.py",
+    "neuronx_distributed_inference_tpu/serving/adapter.py",
+    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
 )
 # region functions that MUST exist when linting the default set — a rename
 # must move coverage, not lose it
 EXPECTED_REGIONS = {
-    "neuronx_distributed_inference_tpu/serving.py": (
+    "neuronx_distributed_inference_tpu/serving/adapter.py": (
         "_dispatch_decode",           # decode pipeline (both adapters)
         "_dispatch_prefill_chunk",    # packed chunked prefill (paged)
+    ),
+    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py": (
+        "_dispatch_engine_pass",      # serving engine dispatch-driving loop
     ),
 }
 

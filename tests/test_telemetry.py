@@ -277,13 +277,13 @@ def test_cb_adapter_records_serving_metrics(live_registry):
     _drive(ContinuousBatchingAdapter(_cb_app()))
 
     ttft = reg.get(tmetrics.REQUEST_TTFT_SECONDS)
-    assert ttft.count(engine="cb") == 2
-    assert ttft.sum(engine="cb") > 0.0
+    assert ttft.count(engine="cb", tenant="") == 2
+    assert ttft.sum(engine="cb", tenant="") > 0.0
     step = reg.get(tmetrics.DECODE_STEP_SECONDS)
     assert step.count(engine="cb") == 6
     assert step.sum(engine="cb") > 0.0
     tpot = reg.get(tmetrics.REQUEST_TPOT_SECONDS)
-    assert tpot.count(engine="cb") == 2
+    assert tpot.count(engine="cb", tenant="") == 2
     req = reg.get(tmetrics.REQUESTS_TOTAL)
     assert req.get(engine="cb", event="added") == 2
     assert req.get(engine="cb", event="released") == 2
@@ -342,7 +342,7 @@ def test_paged_adapter_records_kv_occupancy(live_registry):
     # in-use must drop back to untracked-by-sequences
     assert reg.get(tmetrics.KV_BLOCKS_IN_USE).get() == 0
     # serving + app histograms flowed through the paged engine too
-    assert reg.get(tmetrics.REQUEST_TTFT_SECONDS).count(engine="paged") == 1
+    assert reg.get(tmetrics.REQUEST_TTFT_SECONDS).count(engine="paged", tenant="") == 1
     assert reg.get(tmetrics.DECODE_STEP_SECONDS).count(engine="paged") == 3
     run = reg.get(tmetrics.RUN_SECONDS)
     assert run.count(kind="paged", part="device") >= 4
