@@ -32,6 +32,7 @@ from ..config import InferenceConfig, TpuConfig
 from ..modules import autobucketing
 from ..telemetry import get_registry
 from ..telemetry import metrics as tmetrics
+from ..telemetry import trace as trace_mod
 from ..modules.kv_cache import KVCacheSpec, cache_pspec, init_cache
 from ..ops.sampling import prepare_sampling_params
 from ..parallel.mesh import AXIS_DP, AXIS_TP, MeshConfig, build_mesh, mesh_from_config
@@ -363,21 +364,28 @@ class CausalLMApplication:
         self._telemetry_override = reg
 
     def _tel_start(self):
-        """perf_counter() when telemetry is live, else None (the sentinel
-        keeps the disabled path free of timing work AND of the device sync
-        in :meth:`_tel_end`)."""
-        return time.perf_counter() if self.telemetry.enabled else None
+        """perf_counter() when telemetry OR the flight recorder is live,
+        else None (the sentinel keeps the disabled path free of timing
+        work AND of the device sync in :meth:`_tel_end`)."""
+        if self.telemetry.enabled or trace_mod.get_recorder().enabled:
+            return time.perf_counter()
+        return None
 
     def _tel_end(self, kind: str, t0, out, n_rows: int):
         """Observe one _run_* call: host-prep (entry → dispatch return) vs
         device wait (block_until_ready). Runs strictly OUTSIDE traced code;
-        the sync only happens when telemetry is enabled."""
+        the sync only happens when telemetry is enabled. The flight
+        recorder gets a ``run.<kind>`` slice covering the HOST window only
+        (entry → dispatch return) — recording never adds a device sync."""
         if t0 is None:
             return
+        t1 = time.perf_counter()
+        rec = trace_mod.get_recorder()
+        if rec.enabled:
+            rec.complete(f"run.{kind}", t0, cat="app", t1=t1, rows=n_rows)
         tel = self.telemetry
         if not tel.enabled:
             return
-        t1 = time.perf_counter()
         jax.block_until_ready(out["tokens"])
         t2 = time.perf_counter()
         hist = tmetrics.run_seconds_histogram(tel)
@@ -392,11 +400,17 @@ class CausalLMApplication:
         most useful "why is serving slow" signal. Signatures are tracked
         even while telemetry is disabled (one set-add, no syncs) so that
         enabling the registry after warmup does not misreport every warm
-        graph as a fresh compile."""
+        graph as a fresh compile. First-time signatures also land on the
+        flight recorder as ``compile`` instants, so a trace timeline shows
+        WHERE mid-serving compile stalls interleave with dispatches."""
         key = (kind, bucket, sig)
         seen = key in self._jit_seen
         if not seen:
             self._jit_seen.add(key)
+            rec = trace_mod.get_recorder()
+            if rec.enabled:
+                rec.instant("compile", cat="app", kind=kind,
+                            bucket=str(bucket), sig=str(sig))
         tel = self.telemetry
         if not tel.enabled:
             return

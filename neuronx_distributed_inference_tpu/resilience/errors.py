@@ -27,7 +27,14 @@ __all__ = [
 class ServingError(Exception):
     """Base of the serving failure taxonomy. :attr:`seq_ids` carries the
     affected sequence ids when the failure is attributable to specific
-    rows (empty otherwise), so engines never have to parse messages."""
+    rows (empty otherwise), so engines never have to parse messages.
+
+    :attr:`trace_id` is the flight-recorder event id of the matching
+    ``error.*`` timeline event when the recorder was enabled at raise time
+    (``telemetry.trace``), ``None`` otherwise — a post-mortem dump can
+    jump from the caught exception straight to its place in the trace."""
+
+    trace_id = None                    # set by FlightRecorder.error()
 
     def __init__(self, msg: str, seq_ids: Sequence[int] = ()):
         super().__init__(msg)

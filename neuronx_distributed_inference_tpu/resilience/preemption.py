@@ -54,6 +54,7 @@ class Preempted:
     reason: str                    # "grow" | "admission" | "scheduler"
     deadline: Optional[float] = None   # absolute perf_counter() deadline
     meta: Any = None                   # engine passthrough (tenant, ...)
+    trace_id: Optional[str] = None     # flight-recorder "preempt" event id
 
     def admission_kwargs(self, seq_id: Optional[int] = None,
                          now: Optional[float] = None) -> Dict[str, Any]:

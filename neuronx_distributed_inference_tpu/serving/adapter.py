@@ -115,6 +115,7 @@ from ..resilience.preemption import (PREEMPTION_POLICIES, Preempted,
                                     pick_victim)
 from ..telemetry import get_registry
 from ..telemetry import metrics as tmetrics
+from ..telemetry.trace import get_recorder as _get_recorder
 
 
 @dataclass
@@ -171,6 +172,25 @@ def _meta_tenant(meta: Any) -> str:
         return str(meta.get("tenant", ""))
     except AttributeError:
         return ""
+
+
+def _common_tenant(tenants) -> str:
+    """The single tenant shared by every affected row, or "" when the set
+    is empty or mixed — per-call failure counters label with ONE tenant,
+    and a cross-tenant failure is attributed to none rather than to an
+    arbitrary member."""
+    ts = set(tenants)
+    return ts.pop() if len(ts) == 1 else ""
+
+
+def _trace_error(err):
+    """Record ``err`` on the flight recorder (attaching ``err.trace_id``)
+    when tracing is live; returns ``err`` so raise sites stay one-liners.
+    Idempotent per exception — a re-wrapped error keeps its first event."""
+    rec = _get_recorder()
+    if rec.enabled and getattr(err, "trace_id", None) is None:
+        rec.error(err)
+    return err
 
 
 def _async_fetch(x):
@@ -296,7 +316,7 @@ class _AdapterTelemetry:
             tmetrics.requests_counter(reg).inc(released, engine=self.engine,
                                                event="released")
 
-    def on_preempt(self, seq_id: int, reason: str):
+    def on_preempt(self, seq_id: int, reason: str, tenant: str = ""):
         # like on_release, the span is closed unconditionally so a request
         # preempted after telemetry is disabled cannot leak from _requests
         info = self._requests.pop(seq_id, None)
@@ -306,19 +326,26 @@ class _AdapterTelemetry:
         reg = self.registry
         if reg.enabled:
             tmetrics.preemptions_counter(reg).inc(engine=self.engine,
-                                                  reason=reason)
+                                                  reason=reason,
+                                                  tenant=tenant)
 
-    def on_deadline(self, seq_ids: Sequence[int]):
+    def on_deadline(self, seq_ids: Sequence[int],
+                    tenants: Optional[Sequence[str]] = None):
         reg = self.registry
-        if seq_ids and reg.enabled:
-            tmetrics.deadline_expired_counter(reg).inc(len(seq_ids),
-                                                       engine=self.engine)
+        if not seq_ids or not reg.enabled:
+            return
+        if tenants is None:
+            tenants = [""] * len(seq_ids)
+        counter = tmetrics.deadline_expired_counter(reg)
+        for tenant in tenants:
+            counter.inc(engine=self.engine, tenant=tenant)
 
-    def on_step_failure(self, phase: str):
+    def on_step_failure(self, phase: str, tenant: str = ""):
         reg = self.registry
         if reg.enabled:
             tmetrics.step_failures_counter(reg).inc(engine=self.engine,
-                                                    phase=phase)
+                                                    phase=phase,
+                                                    tenant=tenant)
 
     def on_admission_rollback(self):
         reg = self.registry
@@ -402,19 +429,20 @@ def _pre_step_checks(seqs: Dict[int, _SeqState], live: Sequence[int],
         fresh = [s for s in expired if not seqs[s].expired_reported]
         for s in fresh:
             seqs[s].expired_reported = True
-        telemetry.on_deadline(fresh)
-        raise DeadlineExceeded(
+        telemetry.on_deadline(fresh, [_meta_tenant(seqs[s].meta)
+                                      for s in fresh])
+        raise _trace_error(DeadlineExceeded(
             f"seq_ids {expired} exceeded their wall-clock deadline; "
             "release() them (or re-queue with a fresh budget) and step "
-            "again", seq_ids=expired)
+            "again", seq_ids=expired))
     if seq_len is None:
         return
     over = [s for s in live if seqs[s].position + horizon > seq_len]
     if over:
-        raise CapacityError(
+        raise _trace_error(CapacityError(
             f"decode step (horizon {horizon}) for seq_ids {over} would "
             f"write KV past the compiled seq_len {seq_len}; release them "
-            "or rebuild with a larger seq_len", seq_ids=over)
+            "or rebuild with a larger seq_len", seq_ids=over))
 
 
 def _repeat_row0(x: np.ndarray, pad_to: int) -> np.ndarray:
@@ -595,12 +623,24 @@ class _EngineAdapterBase:
 
     _step_growth = 0              # paged: KV tokens grown per dispatch
 
+    def _tenant_of(self, seq_ids) -> str:
+        """Common tenant label of ``seq_ids`` (running rows), "" when
+        mixed/unknown — failure counters attribute per tenant only when
+        the attribution is unambiguous."""
+        return _common_tenant(_meta_tenant(self.seqs[s].meta)
+                              for s in seq_ids if s in self.seqs)
+
     # -- fetch helpers (the ONLY places that block on device output) -------
     def _fetch_rows(self, out, b: int) -> np.ndarray:
         t0 = time.perf_counter()
         toks = np.asarray(out["tokens"])
+        t1 = time.perf_counter()
         self.host_stats["blocking_fetches"] += 1
-        self.host_stats["blocked_s"] += time.perf_counter() - t0
+        self.host_stats["blocked_s"] += t1 - t0
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.complete("fetch.tokens", t0, cat="adapter", t1=t1,
+                         engine=self.engine_name, rows=b)
         return toks.reshape(toks.shape[0], -1)[:b]
 
     # -- public decode surface ---------------------------------------------
@@ -717,11 +757,11 @@ class _EngineAdapterBase:
         except Exception as e:
             self._rollback_step_growth(live)
             self._scratch = None
-            self.telemetry.on_step_failure("decode")
-            raise StepFailure(
+            self.telemetry.on_step_failure("decode", self._tenant_of(live))
+            raise _trace_error(StepFailure(
                 self._decode_failure_msg + "; positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
-                retry_safe=self.app.cache is cache_before) from e
+                retry_safe=self.app.cache is cache_before)) from e
         res = self._drain_ready()    # first tokens of finished prefills
         for i, s in enumerate(live):
             st = self.seqs[s]
@@ -795,12 +835,12 @@ class _EngineAdapterBase:
             self._rollback_step_growth(live)
             self._scratch = None
             self._inflight = prev
-            self.telemetry.on_step_failure("decode")
-            raise StepFailure(
+            self.telemetry.on_step_failure("decode", self._tenant_of(live))
+            raise _trace_error(StepFailure(
                 self._decode_failure_msg + " at dispatch; the in-flight "
                 "lookahead step was preserved",
                 phase="decode", seq_ids=tuple(live),
-                retry_safe=self.app.cache is cache_before) from e
+                retry_safe=self.app.cache is cache_before)) from e
         rec = _Inflight(
             live=tuple(live),
             states=tuple(self.seqs[s] for s in live),
@@ -878,11 +918,11 @@ class _EngineAdapterBase:
                     st.position -= 1
             self._unwind_inflight_growth(rec)
         self.telemetry.on_dispatch(0)
-        self.telemetry.on_step_failure("decode")
-        raise StepFailure(
+        self.telemetry.on_step_failure("decode", self._tenant_of(seq_ids))
+        raise _trace_error(StepFailure(
             "pipelined decode fetch failed; every in-flight lookahead step "
             "was rolled back to the last delivered token",
-            phase="decode", seq_ids=seq_ids, retry_safe=False) from cause
+            phase="decode", seq_ids=seq_ids, retry_safe=False)) from cause
 
     def _unwind_inflight_growth(self, rec: _Inflight):
         pass
@@ -899,6 +939,25 @@ class _EngineAdapterBase:
         instead of being dropped."""
         for s, t in self.flush().items():
             self._ready[s] = t
+
+    # -- post-mortem snapshot ----------------------------------------------
+    def debug_state(self) -> Dict[str, Any]:
+        """Read-only host-side snapshot for post-mortems (surfaced through
+        :meth:`~..engine.scheduler.ServingEngine.dump_debug_state` and the
+        ``GET /v1/debug/state`` endpoint). JSON-able; never touches device
+        state."""
+        return {
+            "engine": self.engine_name,
+            "running_ids": [int(s) for s in sorted(self.seqs)],
+            "positions": {int(s): int(st.position)
+                          for s, st in self.seqs.items()},
+            "tenants": {int(s): _meta_tenant(st.meta)
+                        for s, st in self.seqs.items()},
+            "pipeline_inflight": (0 if self._inflight is None
+                                  else len(self._inflight.live)),
+            "ready_undelivered": [int(s) for s in sorted(self._ready)],
+            "host_stats": dict(self.host_stats),
+        }
 
 
 class ContinuousBatchingAdapter(_EngineAdapterBase):
@@ -978,10 +1037,10 @@ class ContinuousBatchingAdapter(_EngineAdapterBase):
             raise
         except Exception as e:
             self.telemetry.on_step_failure("prefill")
-            raise StepFailure(
+            raise _trace_error(StepFailure(
                 "prefill device step failed; no sequences were admitted",
                 phase="prefill", seq_ids=seq_ids,
-                retry_safe=self.app.cache is cache_before) from e
+                retry_safe=self.app.cache is cache_before)) from e
         res = {}
         for i, sid in enumerate(seq_ids):
             # no tokens/admit_idx bookkeeping here: the CB adapter has no
@@ -1023,6 +1082,12 @@ class ContinuousBatchingAdapter(_EngineAdapterBase):
         _async_fetch(out["tokens"])
         self.host_stats["dispatches"] += 1
         self.host_stats["device_steps"] += 1
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("dispatch.decode", cat="adapter",
+                        engine=self.engine_name, rows=scr.b,
+                        pad_to=scr.pad_to, seq_ids=list(scr.live),
+                        pipelined=toks_dev is not None)
         return out
 
     def _run_many(self, live: List[int], num_steps: int):
@@ -1049,15 +1114,20 @@ class ContinuousBatchingAdapter(_EngineAdapterBase):
                                             seq_ids=sid)
             self.host_stats["dispatches"] += 1
             self.host_stats["device_steps"] += num_steps
+            rec = _get_recorder()
+            if rec.enabled:
+                rec.instant("dispatch.decode_loop", cat="adapter",
+                            engine=self.engine_name, rows=b, pad_to=pad_to,
+                            steps=num_steps, seq_ids=list(live))
             toks = self._fetch_rows(out, b)
         except ServingError:
             raise
         except Exception as e:
-            self.telemetry.on_step_failure("decode")
-            raise StepFailure(
+            self.telemetry.on_step_failure("decode", self._tenant_of(live))
+            raise _trace_error(StepFailure(
                 "fused decode loop failed; positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
-                retry_safe=self.app.cache is cache_before) from e
+                retry_safe=self.app.cache is cache_before)) from e
         return toks, pad_to
 
     # -- helpers ----------------------------------------------------------
@@ -1228,11 +1298,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
             raise
         except Exception as e:
             self._rollback_admission(begun)
-            self.telemetry.on_step_failure("prefill")
-            raise StepFailure(
+            self.telemetry.on_step_failure("prefill",
+                                           _common_tenant(map(_meta_tenant,
+                                                              metas)))
+            raise _trace_error(StepFailure(
                 "paged admission failed; all allocations from this call "
                 "were rolled back", phase="prefill",
-                seq_ids=seq_ids, retry_safe=True) from e
+                seq_ids=seq_ids, retry_safe=True)) from e
         if self.prefill_budget_tokens is not None:
             return {}          # deferred: step() drives the chunks
         cache_before = app.cache
@@ -1248,11 +1320,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
             raise
         except Exception as e:
             self._rollback_admission(begun)
-            self.telemetry.on_step_failure("prefill")
-            raise StepFailure(
+            self.telemetry.on_step_failure("prefill",
+                                           _common_tenant(map(_meta_tenant,
+                                                              metas)))
+            raise _trace_error(StepFailure(
                 "paged prefill failed; all allocations from this call were "
                 "rolled back", phase="prefill", seq_ids=seq_ids,
-                retry_safe=app.cache is cache_before) from e
+                retry_safe=app.cache is cache_before)) from e
         # telemetry only once the WHOLE call is past rollback — a sibling
         # chunk failure must not leave spans/counters for requests that
         # were never admitted
@@ -1318,6 +1392,12 @@ class PagedEngineAdapter(_EngineAdapterBase):
         _async_fetch(out["tokens"])
         self.host_stats["dispatches"] += 1
         self.host_stats["device_steps"] += 1
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("dispatch.decode", cat="adapter",
+                        engine=self.engine_name, rows=scr.b,
+                        pad_to=scr.pad_to, seq_ids=list(scr.live),
+                        pipelined=toks_dev is not None)
         return out
 
     def _run_many(self, live: List[int], num_steps: int):
@@ -1346,18 +1426,23 @@ class PagedEngineAdapter(_EngineAdapterBase):
             out = app._run_paged_loop(first, pos, bt, num_steps)
             self.host_stats["dispatches"] += 1
             self.host_stats["device_steps"] += num_steps
+            rec = _get_recorder()
+            if rec.enabled:
+                rec.instant("dispatch.decode_loop", cat="adapter",
+                            engine=self.engine_name, rows=b, pad_to=pad_to,
+                            steps=num_steps, seq_ids=list(live))
             toks = self._fetch_rows(out, b)
         except ServingError:
             self._rollback_grow(live, num_steps)
             raise
         except Exception as e:
             self._rollback_grow(live, num_steps)
-            self.telemetry.on_step_failure("decode")
-            raise StepFailure(
+            self.telemetry.on_step_failure("decode", self._tenant_of(live))
+            raise _trace_error(StepFailure(
                 "fused paged decode loop failed; KV growth was rolled back "
                 "and positions were not advanced",
                 phase="decode", seq_ids=tuple(live),
-                retry_safe=app.cache is cache_before) from e
+                retry_safe=app.cache is cache_before)) from e
         return toks, pad_to
 
     # -- scheduler hooks ---------------------------------------------------
@@ -1378,6 +1463,28 @@ class PagedEngineAdapter(_EngineAdapterBase):
         """Batch slots an ``add_requests`` call could still admit into
         (running + pending rows count against the compiled batch)."""
         return self.batch - len(self.seqs) - len(self._chunks)
+
+    def debug_state(self) -> Dict[str, Any]:
+        """Base snapshot plus the paged-only view: pending chunked
+        admissions with prefill progress, batch headroom, block-pool
+        occupancy (incl. unwritten-block tracking) and uncollected
+        preemption records."""
+        state = super().debug_state()
+        mgr = self.app.kv_mgr
+        usable = mgr.spec.num_blocks - 1          # block 0 is the null block
+        free = int(mgr.allocator.num_free)
+        state.update({
+            "pending_prefill": {
+                int(s): {"done": int(c.done), "total": len(c.prompt),
+                         "tenant": _meta_tenant(c.meta)}
+                for s, c in self._chunks.items()},
+            "free_capacity": self.free_capacity,
+            "blocks": {"usable": usable, "free": free,
+                       "in_use": usable - free,
+                       "unwritten": len(self._unwritten)},
+            "preempted_uncollected": [int(r.seq_id) for r in self.preempted],
+        })
+        return state
 
     def prefix_warmth(self, prompt: Sequence[int]) -> int:
         """READ-ONLY probe: how many leading tokens of ``prompt`` an
@@ -1443,22 +1550,36 @@ class PagedEngineAdapter(_EngineAdapterBase):
             # the prefix cache (abort, not a plain free); the record's
             # tokens are the bare prompt — nothing was generated yet
             self._abort_pending(victim)
+            tenant = _meta_tenant(cst.meta)
             self.preempted.append(Preempted(
                 seq_id=victim, tokens=tuple(cst.prompt),
                 prompt_len=len(cst.prompt), n_generated=0, reason=reason,
-                deadline=cst.deadline, meta=cst.meta))
-            self.telemetry.on_preempt(victim, reason)
+                deadline=cst.deadline, meta=cst.meta,
+                trace_id=self._trace_preempt(victim, reason, tenant,
+                                             pending=True)))
+            self.telemetry.on_preempt(victim, reason, tenant)
             return
         st = self.seqs.pop(victim)
         self._scratch = None               # victim's blocks are reclaimed
         if victim in self.app.kv_mgr.tables:
             self.app.kv_mgr.end_sequence(victim)
+        tenant = _meta_tenant(st.meta)
         self.preempted.append(Preempted(
             seq_id=victim, tokens=tuple(st.tokens),
             prompt_len=st.prompt_len,
             n_generated=len(st.tokens) - st.prompt_len, reason=reason,
-            deadline=st.deadline, meta=st.meta))
-        self.telemetry.on_preempt(victim, reason)
+            deadline=st.deadline, meta=st.meta,
+            trace_id=self._trace_preempt(victim, reason, tenant)))
+        self.telemetry.on_preempt(victim, reason, tenant)
+
+    def _trace_preempt(self, victim: int, reason: str, tenant: str,
+                       pending: bool = False) -> Optional[str]:
+        rec = _get_recorder()
+        if not rec.enabled:
+            return None
+        return rec.instant("preempt", cat="adapter",
+                           engine=self.engine_name, seq_id=victim,
+                           reason=reason, tenant=tenant, pending=pending)
 
     def _grow_with_preemption(self, live: Sequence[int],
                               n: int = 1) -> List[int]:
@@ -1555,11 +1676,12 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 fresh = [s for s in hit if not chunks[s].expired_reported]
                 for s in fresh:
                     chunks[s].expired_reported = True
-                self.telemetry.on_deadline(fresh)
-                raise DeadlineExceeded(
+                self.telemetry.on_deadline(
+                    fresh, [_meta_tenant(chunks[s].meta) for s in fresh])
+                raise _trace_error(DeadlineExceeded(
                     f"seq_ids {hit} exceeded their wall-clock deadline "
                     "mid-prefill; release() them (or re-queue with a fresh "
-                    "budget) and step again", seq_ids=hit)
+                    "budget) and step again", seq_ids=hit))
             # expired but not targeted by this step: don't burn budget on
             # them, and don't stall the targeted healthy rows
             order = [s for s in order if s not in expired]
@@ -1578,7 +1700,12 @@ class PagedEngineAdapter(_EngineAdapterBase):
         seq_list = tuple(s for s, *_ in rows)
         final_rows = [(i, s) for i, (s, _, _, fin) in enumerate(rows)
                       if fin]
+        # tenant attribution captured BEFORE any rollback pops the chunk
+        # state (failure counters + trace events need it afterwards)
+        row_tenant = _common_tenant(_meta_tenant(chunks[s].meta)
+                                    for s in seq_list)
         cache_before = self.app.cache
+        t0_chunk = time.perf_counter()
         try:
             if _FAULTS.active:
                 _FAULTS.fire("prefill_chunk")
@@ -1591,17 +1718,26 @@ class PagedEngineAdapter(_EngineAdapterBase):
             # fetch nothing — their samples are discarded unmaterialized.
             new = (self._fetch_prefill_tokens(out) if final_rows
                    else None)
-        except ServingError:
+        except ServingError as e:
             self._abort_prefill_rows(seq_list)
+            _trace_error(e)                # attach a timeline id in place
             raise
         except Exception as e:
             self._abort_prefill_rows(seq_list)
-            self.telemetry.on_step_failure("prefill")
-            raise StepFailure(
+            self.telemetry.on_step_failure("prefill", row_tenant)
+            raise _trace_error(StepFailure(
                 "chunked prefill dispatch failed; every partially-"
                 "prefilled sequence packed in it was rolled back",
                 phase="prefill", seq_ids=seq_list,
-                retry_safe=self.app.cache is cache_before) from e
+                retry_safe=self.app.cache is cache_before)) from e
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.complete("dispatch.prefill_chunk", t0_chunk, cat="adapter",
+                         engine=self.engine_name, seq_ids=list(seq_list),
+                         rows=len(rows), width=int(packed[0].shape[1]),
+                         tokens=sum(n for _, _, n, _ in rows),
+                         final_seq_ids=[s for _, s in final_rows],
+                         tenant=row_tenant)
         bs = self.app.kv_mgr.spec.block_size
         for s, _, n, _ in rows:
             chunks[s].done += n
@@ -1684,8 +1820,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
         blocking sync of a packed admission; async-prefetched)."""
         t0 = time.perf_counter()
         toks = np.asarray(out["tokens"])
+        t1 = time.perf_counter()
         self.host_stats["prefill_blocking_fetches"] += 1
-        self.host_stats["prefill_blocked_s"] += time.perf_counter() - t0
+        self.host_stats["prefill_blocked_s"] += t1 - t0
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.complete("fetch.tokens", t0, cat="adapter", t1=t1,
+                         engine=self.engine_name, phase="prefill")
         return toks.reshape(toks.shape[0], -1)
 
     def _drop_unwritten(self, sid):

@@ -26,6 +26,14 @@ engine's submit / stream / cancel / metrics surface:
       Prometheus text exposition of the process-global registry.
   ``GET /healthz``
       ``{"ok": true, "queue_depth": n, "running": m}``.
+  ``GET /v1/debug/state``
+      Post-mortem JSON (schema ``nxdi-debug-state-v1``): engine/adapter
+      snapshot (per-tenant queue depths, running/pending ids, block
+      occupancy, pipeline depth) plus the flight-recorder tail with its
+      drop count. Works with the recorder disabled (empty trace).
+  ``GET /v1/debug/trace``
+      The flight recorder as Chrome trace-event JSON — save the body and
+      open it in ``chrome://tracing`` / Perfetto.
 
 Client-gone behaviour: when an SSE write fails (peer reset / closed), the
 front end cancels the request through the engine — blocks are reclaimed
@@ -43,6 +51,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ...resilience.errors import AdmissionError, QueueOverflow, ServingError
 from ...telemetry import get_registry
+from ...telemetry.trace import get_recorder
 from .scheduler import ServingEngine
 from .streams import TokenStream
 
@@ -150,6 +159,15 @@ class ServingFrontend:
             text = get_registry().render_prometheus()
             await self._send_raw(writer, 200, text.encode(),
                                  "text/plain; version=0.0.4")
+        elif path == "/v1/debug/state" and method == "GET":
+            # live post-mortem: engine/adapter snapshot + flight-recorder
+            # tail (events empty while the recorder is disabled)
+            await self._send_json(writer, 200,
+                                  self.engine.dump_debug_state())
+        elif path == "/v1/debug/trace" and method == "GET":
+            # Chrome trace-event JSON — save the body and load it in
+            # chrome://tracing or Perfetto
+            await self._send_json(writer, 200, get_recorder().to_chrome())
         elif path == "/v1/generate" and method == "POST":
             spec = self._parse_spec(body)
             stream = self._submit(spec)

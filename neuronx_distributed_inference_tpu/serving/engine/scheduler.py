@@ -39,14 +39,17 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import json
+import os
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 from ...resilience.errors import (AdmissionError, CapacityError,
                                   ConfigurationError, DeadlineExceeded,
                                   ServingError, StepFailure)
 from ...telemetry import get_registry
 from ...telemetry import metrics as tmetrics
+from ...telemetry.trace import get_recorder as _get_recorder
 from .queue import MultiTenantQueue, QueuedRequest
 from .streams import TokenStream
 
@@ -75,7 +78,8 @@ class ServingEngine:
                  starvation_bound_s: float = 2.0,
                  max_unread_tokens: Optional[int] = None,
                  decode_steps_per_pass: int = 1,
-                 priority_preemption: bool = True):
+                 priority_preemption: bool = True,
+                 debug_dump_dir: Optional[str] = None):
         for hook in ("take_preempted", "preempt", "prefix_warmth",
                      "free_capacity", "pending_prefill_ids"):
             if not hasattr(adapter, hook):
@@ -91,6 +95,9 @@ class ServingEngine:
         self.decode_steps_per_pass = decode_steps_per_pass
         self.max_unread_tokens = max_unread_tokens
         self.priority_preemption = priority_preemption
+        # post-mortem artifacts: when set, an unrecoverable StepFailure
+        # writes dump_debug_state() here before the engine closes
+        self.debug_dump_dir = debug_dump_dir
         self._active: Dict[int, QueuedRequest] = {}     # seq_id -> request
         self._sid_of: Dict[str, int] = {}               # request_id -> seq
         self._seq_ids = itertools.count()
@@ -179,17 +186,26 @@ class ServingEngine:
 
     def run_pass(self) -> int:
         """One closed-loop scheduling pass (see the module docstring).
-        Returns the number of tokens delivered to streams."""
+        Returns the number of tokens delivered to streams. With the flight
+        recorder enabled each stage lands as a ``pass.*`` complete slice
+        on the trace timeline (stable names: ``pass.expire``,
+        ``pass.preempt``, ``pass.admit``, ``pass.dispatch``; the adapter
+        adds ``dispatch.*``/``fetch.*`` inside the dispatch slice)."""
         now = time.perf_counter()
-        self._expire_queue(now)
-        self._collect_preempted()
-        self._priority_preempt()
-        self._admit(now)
-        # admission may itself have preempted running victims for blocks
-        # (reason="admission"): requeue them before the dispatch stage so
-        # their dead seq_ids never reach a step call
-        self._collect_preempted()
-        return self._dispatch_engine_pass()
+        rec = _get_recorder()            # disabled: span() is a no-op CM
+        with rec.span("pass.expire", cat="engine"):
+            self._expire_queue(now)
+        with rec.span("pass.preempt", cat="engine"):
+            self._collect_preempted()
+            self._priority_preempt()
+        with rec.span("pass.admit", cat="engine"):
+            self._admit(now)
+            # admission may itself have preempted running victims for
+            # blocks (reason="admission"): requeue them before the
+            # dispatch stage so their dead seq_ids never reach a step call
+            self._collect_preempted()
+        with rec.span("pass.dispatch", cat="engine"):
+            return self._dispatch_engine_pass()
 
     def run_until_drained(self, max_passes: int = 100000) -> None:
         """Drive :meth:`run_pass` until no queued or running work remains
@@ -230,14 +246,20 @@ class ServingEngine:
 
     # -- pass stages -------------------------------------------------------
     def _expire_queue(self, now: float) -> None:
+        rec = _get_recorder()
         for req in self.queue.expire(now):
             self._observe_wait(req, "expired")
             reg = get_registry()
             if reg.enabled:
-                tmetrics.deadline_expired_counter(reg).inc(engine="queue")
-            req.stream.finish("deadline", DeadlineExceeded(
+                tmetrics.deadline_expired_counter(reg).inc(
+                    engine="queue", tenant=req.tenant)
+            err = DeadlineExceeded(
                 f"request {req.request_id} expired after "
-                f"{now - req.enqueue_t:.3f}s in queue"))
+                f"{now - req.enqueue_t:.3f}s in queue")
+            if rec.enabled:
+                rec.error(err, request_id=req.request_id,
+                          tenant=req.tenant, where="queue")
+            req.stream.finish("deadline", err)
             self.stats["expired_queue"] += 1
 
     def _collect_preempted(self) -> None:
@@ -436,6 +458,11 @@ class ServingEngine:
         for sid, toks in res.items():
             toks = toks if isinstance(toks, list) else [toks]
             n += self._deliver(sid, toks)
+        if n:
+            rec = _get_recorder()
+            if rec.enabled:
+                rec.instant("stream.deliver", cat="engine", tokens=n,
+                            seq_ids=[int(s) for s in res])
         return n
 
     def _deliver(self, sid: int, toks: List[int]) -> int:
@@ -501,7 +528,20 @@ class ServingEngine:
 
     def _fatal(self, err: StepFailure) -> None:
         """Unrecoverable device failure: every stream is failed; the
-        adapter (and its application) must be rebuilt before serving."""
+        adapter (and its application) must be rebuilt before serving.
+        With ``debug_dump_dir`` set, the post-mortem (flight-recorder tail
+        + engine/adapter snapshot) is written BEFORE the teardown empties
+        the state it describes."""
+        if self.debug_dump_dir is not None:
+            try:
+                self.dump_debug_state(
+                    os.path.join(self.debug_dump_dir,
+                                 f"nxdi_postmortem_{id(err):x}.json"),
+                    error=err)
+            except Exception:
+                # the dump must never mask the error OR abort the stream
+                # teardown below (e.g. a non-JSON-able recorded arg)
+                pass
         self._closed = True
         for sid in list(self._active):
             req = self._retire(sid)
@@ -509,6 +549,69 @@ class ServingEngine:
         for req in list(self._queued()):
             self.queue.remove(req.request_id)
             req.stream.finish("error", err)
+
+    # -- post-mortem surface ----------------------------------------------
+    def debug_state(self) -> Dict[str, Any]:
+        """Read-only JSON-able snapshot of the scheduler + adapter:
+        per-tenant queue depths, active requests (seq_id, tenant,
+        priority, delivered tokens), reservation state and the adapter's
+        own view (running/pending ids, block occupancy, pipeline depth).
+        Served live by ``GET /v1/debug/state``."""
+        per_tenant = {t: self.queue.depth_of(t)
+                      for t in self.queue._heaps if self.queue.depth_of(t)}
+        active = {
+            int(sid): {"request_id": req.request_id, "tenant": req.tenant,
+                       "priority": req.priority,
+                       "n_tokens": req.stream.n_tokens,
+                       "max_new_tokens": req.max_new_tokens,
+                       "n_preemptions": req.n_preemptions}
+            for sid, req in self._active.items()}
+        adapter = (self.adapter.debug_state()
+                   if hasattr(self.adapter, "debug_state") else {})
+        return {
+            "closed": self._closed,
+            "stats": dict(self.stats),
+            "queue": {"depth": self.queue.depth, "per_tenant": per_tenant},
+            "active": active,
+            "reserved": list(self._reserved),
+            "adapter": adapter,
+        }
+
+    def dump_debug_state(self, path: Optional[str] = None,
+                         error: Optional[BaseException] = None,
+                         trace_tail: int = 256) -> Dict[str, Any]:
+        """Assemble (and optionally write) one post-mortem artifact: the
+        engine/adapter snapshot, the newest ``trace_tail`` flight-recorder
+        events with the ring's own drop count (so the artifact states its
+        truncation), and the failing error's identity + ``trace_id`` when
+        one is given. Returns the JSON-able dict; writes it to ``path``
+        when provided (parent directories are created)."""
+        rec = _get_recorder()
+        dump: Dict[str, Any] = {
+            "schema": "nxdi-debug-state-v1",
+            "error": None if error is None else {
+                "type": type(error).__name__,
+                "message": str(error),
+                "seq_ids": [int(s) for s in
+                            getattr(error, "seq_ids", ()) or ()],
+                "phase": getattr(error, "phase", None),
+                "retry_safe": getattr(error, "retry_safe", None),
+                "trace_id": getattr(error, "trace_id", None),
+            },
+            "engine": self.debug_state(),
+            "trace": {
+                "enabled": rec.enabled,
+                "events": rec.tail(trace_tail),
+                "dropped": rec.dropped,
+                "capacity": rec.capacity,
+            },
+        }
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as fh:
+                json.dump(dump, fh, indent=1)
+            dump["artifact_path"] = path
+        return dump
 
     # -- helpers -----------------------------------------------------------
     def _queued(self):

@@ -20,6 +20,9 @@ from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, NULL_REGISTRY, NullRegistry, disable,
                        enable, get_registry, set_registry)
 from .spans import NULL_SPAN, NullSpan, Span
+from .trace import (FlightRecorder, NULL_RECORDER, NullFlightRecorder,
+                    disable_recorder, enable_recorder, get_recorder,
+                    set_recorder)
 
 __all__ = [
     "metrics",
@@ -27,4 +30,6 @@ __all__ = [
     "MetricsRegistry", "NULL_REGISTRY", "NullRegistry",
     "enable", "disable", "get_registry", "set_registry",
     "Span", "NullSpan", "NULL_SPAN",
+    "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
+    "enable_recorder", "disable_recorder", "get_recorder", "set_recorder",
 ]

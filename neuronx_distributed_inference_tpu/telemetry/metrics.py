@@ -34,10 +34,19 @@ HOST_OVERLAP_SECONDS = "nxdi_host_overlap_seconds"      # engine
 STEPS_PER_FETCH = "nxdi_steps_per_fetch"                # engine
 
 # -- serving resilience (serving.py + resilience/) --------------------------
-PREEMPTIONS_TOTAL = "nxdi_preemptions_total"            # engine, reason
+PREEMPTIONS_TOTAL = "nxdi_preemptions_total"            # engine, reason, tenant
 ADMISSION_ROLLBACKS_TOTAL = "nxdi_admission_rollbacks_total"   # engine
-DEADLINE_EXPIRED_TOTAL = "nxdi_deadline_expired_total"  # engine
-STEP_FAILURES_TOTAL = "nxdi_step_failures_total"        # engine, phase
+DEADLINE_EXPIRED_TOTAL = "nxdi_deadline_expired_total"  # engine, tenant
+STEP_FAILURES_TOTAL = "nxdi_step_failures_total"        # engine, phase, tenant
+
+# -- flight recorder + span ring (telemetry/trace.py, registry.py) -----------
+TRACE_EVENTS_DROPPED_TOTAL = "nxdi_trace_events_dropped_total"  # ring
+
+# -- compiled-graph observatory (telemetry/observatory.py) -------------------
+COMPILE_SECONDS = "nxdi_compile_seconds"                # kind, bucket
+GRAPH_FLOPS = "nxdi_graph_flops"                        # kind, bucket
+GRAPH_BYTES = "nxdi_graph_bytes"                        # kind, bucket
+GRAPH_PEAK_BYTES = "nxdi_graph_peak_bytes"              # kind, bucket
 
 # -- application hot paths (models/application.py) --------------------------
 # kind: prefill|decode|decode_loop|paged ; part: host|device
@@ -167,11 +176,13 @@ def steps_per_fetch_histogram(reg):
 
 
 def preemptions_counter(reg):
+    # tenant label: "" outside the multi-tenant serving engine (additive —
+    # single-tenant dashboards aggregate over it unchanged)
     return reg.counter(
         PREEMPTIONS_TOTAL,
-        "Sequences evicted under KV block pressure (recompute preemption); "
-        "reason=grow|admission",
-        labels=("engine", "reason"))
+        "Sequences evicted (recompute preemption); "
+        "reason=grow|admission|scheduler",
+        labels=("engine", "reason", "tenant"))
 
 
 def admission_rollbacks_counter(reg):
@@ -185,16 +196,56 @@ def deadline_expired_counter(reg):
     return reg.counter(
         DEADLINE_EXPIRED_TOTAL,
         "Requests that blew their per-request wall-clock deadline "
-        "(counted once per request)",
-        labels=("engine",))
+        "(counted once per request; tenant=\"\" outside the engine)",
+        labels=("engine", "tenant"))
 
 
 def step_failures_counter(reg):
     return reg.counter(
         STEP_FAILURES_TOTAL,
         "Device steps that raised and were rolled back (StepFailure); "
-        "phase=prefill|decode",
-        labels=("engine", "phase"))
+        "phase=prefill|decode (tenant=\"\" outside the engine or when the "
+        "failed call mixed tenants)",
+        labels=("engine", "phase", "tenant"))
+
+
+def trace_events_dropped_counter(reg):
+    return reg.counter(
+        TRACE_EVENTS_DROPPED_TOTAL,
+        "Events evicted from a bounded observability ring "
+        "(ring=spans|trace) — nonzero means post-mortems are truncated",
+        labels=("ring",))
+
+
+def compile_seconds_gauge(reg):
+    return reg.gauge(
+        COMPILE_SECONDS,
+        "AOT lower+compile wall time of one (kind, bucket) serving graph "
+        "(s); the stats_line total tracks cold-start cost",
+        labels=("kind", "bucket"))
+
+
+def graph_flops_gauge(reg):
+    return reg.gauge(
+        GRAPH_FLOPS,
+        "XLA cost_analysis flops of one compiled (kind, bucket) graph",
+        labels=("kind", "bucket"))
+
+
+def graph_bytes_gauge(reg):
+    return reg.gauge(
+        GRAPH_BYTES,
+        "XLA cost_analysis bytes accessed of one compiled (kind, bucket) "
+        "graph",
+        labels=("kind", "bucket"))
+
+
+def graph_peak_bytes_gauge(reg):
+    return reg.gauge(
+        GRAPH_PEAK_BYTES,
+        "XLA memory_analysis peak bytes (arguments + outputs + temps) of "
+        "one compiled (kind, bucket) graph",
+        labels=("kind", "bucket"))
 
 
 def run_seconds_histogram(reg):

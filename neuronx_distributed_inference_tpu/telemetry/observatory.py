@@ -1,0 +1,239 @@
+"""Compiled-graph observatory — static truth about the serving graphs.
+
+AOT-lowers and compiles every (kind, bucket, batch) graph an application's
+bucket ladders imply (``jax.jit(...).lower(...).compile()`` — no execution,
+no device state touched) and harvests XLA's own static analysis:
+
+  * ``cost_analysis()``   — flops and bytes accessed per invocation;
+  * ``memory_analysis()`` — argument/output/temp byte footprints (peak ≈
+    arguments + outputs + temps);
+  * compile wall time per graph (the cold-start cost item 5 of the
+    ROADMAP tracks: ``compile_plus_first_gen_s`` grew 5.7s→14.3s).
+
+All of it works on the CPU backend — this is the evidence base for
+re-earning the frozen kernel-admission constants (``model_base.py``
+heuristics) and for AOT warm-start work, WITHOUT waiting for TPU hardware
+(cf. full-program XLA compilation analysis, PAPERS.md arxiv 1810.09868).
+
+Per-graph results land in the metrics registry when one is live
+(``nxdi_compile_seconds`` / ``nxdi_graph_flops`` / ``nxdi_graph_bytes`` /
+``nxdi_graph_peak_bytes``, labels ``kind``+``bucket``) and in the returned
+report dict (schema ``nxdi-graph-report-v1``), which also carries a static
+roofline estimate per bucket: arithmetic intensity, the
+compute-vs-memory-bound verdict, and the estimated step time under the
+assumed peak flops / HBM bandwidth (``NXDI_TPU_PEAK_TFLOPS``, default 197
+— v5e bf16; ``NXDI_TPU_HBM_GBPS``, default 819).
+
+``bench.py --graph-report`` drives this on the tiny synthetic model and
+commits the artifact (``artifacts/graph_report_r08.json``) so cold-start
+and graph-size regressions show up in BENCH_* rounds with no hardware.
+
+Compiling through fresh ``jax.jit`` wrappers keeps the application's own
+jit cache keys untouched — running the observatory can never change what
+the serving path executes (the XLA persistent compile cache still
+deduplicates the work).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import metrics as tmetrics
+from .registry import get_registry
+
+__all__ = ["analyze_app", "GRAPH_REPORT_SCHEMA"]
+
+GRAPH_REPORT_SCHEMA = "nxdi-graph-report-v1"
+
+
+def _cost(compiled) -> Tuple[float, float]:
+    """(flops, bytes accessed) from XLA cost analysis; zeros when the
+    backend reports nothing. Handles both the dict and the legacy
+    list-of-dicts return shape."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return 0.0, 0.0
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    return (float(ca.get("flops", 0.0) or 0.0),
+            float(ca.get("bytes accessed", 0.0) or 0.0))
+
+
+def _memory(compiled) -> Optional[Dict[str, int]]:
+    """Byte footprints from XLA memory analysis; None when the backend
+    does not expose it. ``peak_bytes`` approximates live memory as
+    arguments + outputs + temps (donated aliases excluded by XLA)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+
+    def g(attr: str) -> int:
+        return int(getattr(ma, attr, 0) or 0)
+
+    out = {
+        "argument_bytes": g("argument_size_in_bytes"),
+        "output_bytes": g("output_size_in_bytes"),
+        "temp_bytes": g("temp_size_in_bytes"),
+        "alias_bytes": g("alias_size_in_bytes"),
+        "generated_code_bytes": g("generated_code_size_in_bytes"),
+    }
+    out["peak_bytes"] = (out["argument_bytes"] + out["output_bytes"]
+                         + out["temp_bytes"])
+    return out
+
+
+def _graph_entries(app) -> List[Tuple[str, str, Callable[[], Tuple]]]:
+    """Enumerate the (kind, bucket_label, build) entries of ``app``'s
+    bucket ladders — the same graphs ``warmup()`` would run, but built
+    through FRESH jit wrappers so lowering never touches the app's
+    compiled-callable cache. ``build()`` returns (jitted_fn, args, kwargs)
+    ready for ``.lower()``."""
+    cfg = app.tpu_config
+    rng = jax.random.PRNGKey(0)
+    entries: List[Tuple[str, str, Callable[[], Tuple]]] = []
+    chunk = max(cfg.decode_chunk_tokens, 1)
+
+    if getattr(cfg, "is_block_kv_layout", False):
+        b = cfg.batch_size
+        width_bt = app.max_blocks
+
+        def paged_args(w: int, b=b):
+            return (app.params, app.cache,
+                    np.zeros((b, w), np.int32), np.zeros((b, w), np.int32),
+                    np.full((b, w), -1, np.int32),
+                    np.zeros((b, width_bt), np.int32),
+                    np.zeros((b,), np.int32),
+                    app._default_sampling_params(b), rng)
+
+        for w in app.ctx_buckets:
+            entries.append((
+                "paged", f"w{w}xb{b}",
+                lambda w=w: (app._jit_paged(), paged_args(w), {})))
+        entries.append((
+            "paged", f"w1xb{b}",
+            lambda: (app._jit_paged(), paged_args(1), {})))
+        if chunk > 1:
+            entries.append((
+                "paged_loop", f"k{chunk}xb{b}",
+                lambda: (app._jit_paged_loop(chunk),
+                         (app.params, app.cache, np.zeros((b,), np.int32),
+                          np.zeros((b,), np.int32),
+                          np.zeros((b, width_bt), np.int32),
+                          app._default_sampling_params(b), rng), {})))
+        return entries
+
+    cb = cfg.ctx_batch_size
+
+    def prefill_args(s: int, b: int):
+        return (app.params, app.cache, np.zeros((b, s), np.int32),
+                np.zeros((b, s), np.int32), np.arange(b, dtype=np.int32),
+                np.ones((b,), np.int32), app._default_sampling_params(b),
+                rng, None, app.replacements, None, None, None, None)
+
+    for s in app.ctx_buckets:
+        entries.append((
+            "prefill", f"ctx{s}xb{cb}",
+            lambda s=s: (app._jit_prefill(), prefill_args(s, cb), {})))
+    for bb in app.batch_buckets:
+        entries.append((
+            "decode", f"b{bb}",
+            lambda bb=bb: (app._jit_decode(None),
+                           (app.params, app.cache,
+                            np.zeros((bb, 1), np.int32),
+                            np.zeros((bb, 1), np.int32),
+                            np.arange(bb, dtype=np.int32),
+                            app._default_sampling_params(bb), rng,
+                            None, app.replacements, None), {})))
+        if chunk > 1:
+            entries.append((
+                "decode_loop", f"b{bb}xk{chunk}",
+                lambda bb=bb: (app._jit_decode_loop(chunk),
+                               (app.params, app.cache,
+                                np.zeros((bb,), np.int32),
+                                np.zeros((bb,), np.int32),
+                                np.arange(bb, dtype=np.int32),
+                                app._default_sampling_params(bb), rng),
+                               {"num_steps": chunk})))
+    return entries
+
+
+def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
+                peak_tflops: Optional[float] = None) -> Dict[str, Any]:
+    """AOT-compile every bucket-ladder graph of ``app`` and return the
+    graph report (see module docstring). Gauges are recorded on
+    ``registry`` (default: the process-global one) when it is enabled."""
+    reg = registry if registry is not None else get_registry()
+    if hbm_gbps is None:
+        hbm_gbps = float(os.environ.get("NXDI_TPU_HBM_GBPS", "819"))
+    if peak_tflops is None:
+        peak_tflops = float(os.environ.get("NXDI_TPU_PEAK_TFLOPS", "197"))
+    if app.params is None:
+        raise ValueError("load_weights() or init_random_weights() first")
+    if app.cache is None:
+        raise ValueError("init_cache() first")
+    graphs: List[Dict[str, Any]] = []
+    for kind, bucket, build in _graph_entries(app):
+        fn, args, kwargs = build()
+        t0 = time.perf_counter()
+        with app._mesh_ctx():
+            compiled = fn.lower(*args, **kwargs).compile()
+        compile_s = time.perf_counter() - t0
+        flops, bytes_acc = _cost(compiled)
+        mem = _memory(compiled)
+        peak = mem["peak_bytes"] if mem else 0
+        roofline = None
+        if peak_tflops > 0 and hbm_gbps > 0:
+            # a zero assumption means "unknown chip" — the static
+            # flops/bytes/compile data is still valid without a roofline
+            t_compute = flops / (peak_tflops * 1e12)
+            t_memory = bytes_acc / (hbm_gbps * 1e9)
+            roofline = {
+                "est_step_ms": round(max(t_compute, t_memory) * 1e3, 6),
+                "bound": ("compute" if t_compute >= t_memory
+                          else "memory"),
+            }
+        graph: Dict[str, Any] = {
+            "kind": kind,
+            "bucket": bucket,
+            "compile_seconds": round(compile_s, 4),
+            "flops": flops,
+            "bytes_accessed": bytes_acc,
+            "memory": mem,
+            "arithmetic_intensity": (round(flops / bytes_acc, 3)
+                                     if bytes_acc else None),
+            "roofline": roofline,
+        }
+        graphs.append(graph)
+        if reg.enabled:
+            tmetrics.compile_seconds_gauge(reg).set(compile_s, kind=kind,
+                                                    bucket=bucket)
+            tmetrics.graph_flops_gauge(reg).set(flops, kind=kind,
+                                                bucket=bucket)
+            tmetrics.graph_bytes_gauge(reg).set(bytes_acc, kind=kind,
+                                                bucket=bucket)
+            tmetrics.graph_peak_bytes_gauge(reg).set(peak, kind=kind,
+                                                     bucket=bucket)
+    return {
+        "schema": GRAPH_REPORT_SCHEMA,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "assumptions": {"hbm_gbps": hbm_gbps, "peak_tflops": peak_tflops},
+        "graphs": graphs,
+        "totals": {
+            "graphs": len(graphs),
+            "compile_seconds": round(sum(g["compile_seconds"]
+                                         for g in graphs), 4),
+            "flops": sum(g["flops"] for g in graphs),
+            "bytes_accessed": sum(g["bytes_accessed"] for g in graphs),
+        },
+    }

@@ -247,6 +247,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._spans: List[Dict[str, Any]] = []
         self._max_spans = max_spans
+        self.spans_dropped = 0
 
     # -- instruments ------------------------------------------------------
     def _get_or_create(self, cls, name, help, labels, **kw) -> _Metric:
@@ -289,8 +290,17 @@ class MetricsRegistry:
     def record_span(self, span_dict: Dict[str, Any]):
         with self._lock:
             self._spans.append(span_dict)
-            if len(self._spans) > self._max_spans:
-                del self._spans[:len(self._spans) - self._max_spans]
+            excess = len(self._spans) - self._max_spans
+            if excess > 0:
+                del self._spans[:excess]
+                self.spans_dropped += excess
+        if excess > 0:
+            # evictions were silent before the flight-recorder work: count
+            # them so a snapshot/post-mortem states its own truncation
+            # (counter registration outside self._lock — it re-takes it)
+            from . import metrics as tmetrics
+            tmetrics.trace_events_dropped_counter(self).inc(excess,
+                                                            ring="spans")
 
     @property
     def spans(self) -> List[Dict[str, Any]]:

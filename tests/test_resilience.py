@@ -430,7 +430,7 @@ def test_preemption_on_grow_reclaims_and_recomputes(paged_app):
     assert paged_app.kv_mgr.allocator.num_free > free_with_both
     assert eng.take_preempted() == []               # drained
     assert reg.get(tmetrics.PREEMPTIONS_TOTAL).get(
-        engine="paged", reason="grow") == 1
+        engine="paged", reason="grow", tenant="") == 1
 
     for _ in range(3):
         got1.append(eng.step()[0])
@@ -490,7 +490,8 @@ def test_deadline_exceeded_is_typed_and_counted_once(cb_app):
     assert ei.value.seq_ids == (0,)
     with pytest.raises(DeadlineExceeded):           # still not released
         eng.step()
-    assert reg.get(tmetrics.DEADLINE_EXPIRED_TOTAL).get(engine="cb") == 1
+    assert reg.get(tmetrics.DEADLINE_EXPIRED_TOTAL).get(engine="cb",
+                                                        tenant="") == 1
     eng.release([0])
     assert eng.step() == {}                         # nothing live: clean
 
