@@ -24,6 +24,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _tiny_llama_hf():
+    """The synthetic tiny-llama config every CPU microbench builds (one
+    copy here; scripts/check_spmd_sharding.py pins its own — the lint
+    must stay runnable standalone)."""
+    return dict(model_type="llama", hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, head_dim=16, vocab_size=512,
+                rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
+                tie_word_embeddings=False, torch_dtype="float32")
+
+
 def host_overhead_main():
     """CPU-runnable host-overhead microbench (ISSUE 3): drives the CB
     serving adapter's decode paths on a tiny synthetic model and reports
@@ -45,11 +56,7 @@ def host_overhead_main():
     from neuronx_distributed_inference_tpu.serving import \
         ContinuousBatchingAdapter
 
-    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
-              num_hidden_layers=2, num_attention_heads=4,
-              num_key_value_heads=2, head_dim=16, vocab_size=512,
-              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
-              tie_word_embeddings=False, torch_dtype="float32")
+    hf = _tiny_llama_hf()
     batch, n_steps, chunk = 2, 48, 8
     tcfg = TpuConfig(batch_size=batch, seq_len=128, dtype="float32",
                      enable_bucketing=True, context_encoding_buckets=[16],
@@ -130,11 +137,7 @@ def prefill_overhead_main(artifact_path="artifacts/bench_prefill_r07.json"):
         LlamaFamily, LlamaInferenceConfig)
     from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
 
-    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
-              num_hidden_layers=2, num_attention_heads=4,
-              num_key_value_heads=2, head_dim=16, vocab_size=512,
-              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
-              tie_word_embeddings=False, torch_dtype="float32")
+    hf = _tiny_llama_hf()
     # 2-D bucketing: a lone straggler row pads to batch bucket 1, not 2 —
     # half the packed path's win for skewed batches
     tcfg = TpuConfig(batch_size=2, seq_len=192, dtype="float32",
@@ -219,11 +222,7 @@ def serving_load_main(artifact_path="artifacts/bench_serving_r08.json"):
     from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
     from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
 
-    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
-              num_hidden_layers=2, num_attention_heads=4,
-              num_key_value_heads=2, head_dim=16, vocab_size=512,
-              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
-              tie_word_embeddings=False, torch_dtype="float32")
+    hf = _tiny_llama_hf()
     batch, max_new, prompt_len = 8, 16, 10
     weights = {"a": 1.0, "b": 1.0, "c": 2.0}
     # closed loop at 2x oversubscription: each tenant keeps twice its
@@ -349,53 +348,13 @@ def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
     required: this is the hardware-free evidence trail for cold-start
     (compile-seconds) and graph-size regressions, and the baseline for
     re-earning the frozen kernel-admission constants (ROADMAP item 5)."""
+    from neuronx_distributed_inference_tpu.telemetry import observatory
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass  # backend already initialized (e.g. under a test runner)
 
-    from neuronx_distributed_inference_tpu import telemetry
-    from neuronx_distributed_inference_tpu.config import TpuConfig
-    from neuronx_distributed_inference_tpu.models.application import (
-        CausalLMApplication, PagedCausalLMApplication)
-    from neuronx_distributed_inference_tpu.models.llama import (
-        LlamaFamily, LlamaInferenceConfig)
-    from neuronx_distributed_inference_tpu.telemetry import observatory
-
-    hf = dict(model_type="llama", hidden_size=64, intermediate_size=128,
-              num_hidden_layers=2, num_attention_heads=4,
-              num_key_value_heads=2, head_dim=16, vocab_size=512,
-              rms_norm_eps=1e-5, rope_theta=10000.0, hidden_act="silu",
-              tie_word_embeddings=False, torch_dtype="float32")
-    reg = telemetry.enable()
-    reports = {}
-
-    tcfg = TpuConfig(batch_size=2, seq_len=128, dtype="float32",
-                     enable_bucketing=True,
-                     context_encoding_buckets=[16, 64],
-                     is_block_kv_layout=True, pa_block_size=16,
-                     is_prefix_caching=True)
-    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
-                                   LlamaFamily)
-    app.init_random_weights(seed=0).init_cache()
-    reports["paged"] = observatory.analyze_app(app)
-
-    tcfg2 = TpuConfig(batch_size=2, seq_len=128, dtype="float32",
-                      enable_bucketing=True,
-                      context_encoding_buckets=[16, 64],
-                      is_continuous_batching=True, decode_chunk_tokens=8)
-    app2 = CausalLMApplication(None, LlamaInferenceConfig(tcfg2, **hf),
-                               LlamaFamily)
-    app2.init_random_weights(seed=0).init_cache()
-    reports["cb"] = observatory.analyze_app(app2)
-
-    # the heartbeat line carries the compile-seconds totals, so BENCH_*
-    # rounds surface cold-start regressions without hardware
-    line = reg.stats_line()
-    if line:
-        print(f"[bench telemetry | graph report] {line}", file=sys.stderr)
-    telemetry.disable()
-
+    reports = _observatory_reports(mesh=False, label="graph report")
     total_compile = round(sum(r["totals"]["compile_seconds"]
                               for r in reports.values()), 4)
     payload = {
@@ -409,13 +368,110 @@ def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
             "apps": reports,
         },
     }
+    _emit_report_artifact(payload, artifact_path, "graph-report")
+
+
+def _observatory_reports(mesh, label):
+    """Build the tiny paged + cb serving apps (on the dp2 x tp2 CPU mesh
+    when ``mesh``) and run the compiled-graph observatory over both —
+    the shared core of ``--graph-report`` and ``--sharding-report``. The
+    heartbeat line carries the gauge totals (compile seconds, collective
+    bytes) so BENCH_* rounds surface regressions without hardware."""
+    from neuronx_distributed_inference_tpu import telemetry
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import (
+        CausalLMApplication, PagedCausalLMApplication)
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.telemetry import observatory
+
+    hf = _tiny_llama_hf()
+    mesh_fields = dict(tp_degree=4, attention_dp_degree=2) if mesh else {}
+
+    def analyze(cls, tcfg):
+        # the application derives its mesh from tcfg's degree fields
+        app = cls(None, LlamaInferenceConfig(tcfg, **hf), LlamaFamily)
+        app.init_random_weights(seed=0).init_cache()
+        return observatory.analyze_app(app)
+
+    reg = telemetry.enable()
+    try:
+        reports = {
+            "paged": analyze(PagedCausalLMApplication, TpuConfig(
+                batch_size=2, seq_len=128, dtype="float32",
+                enable_bucketing=True, context_encoding_buckets=[16, 64],
+                is_block_kv_layout=True, pa_block_size=16,
+                is_prefix_caching=True,
+                **(dict(decode_chunk_tokens=4, **mesh_fields)
+                   if mesh else {}))),
+            "cb": analyze(CausalLMApplication, TpuConfig(
+                batch_size=2, seq_len=128, dtype="float32",
+                enable_bucketing=True, context_encoding_buckets=[16, 64],
+                is_continuous_batching=True, decode_chunk_tokens=8,
+                **mesh_fields)),
+        }
+        line = reg.stats_line()
+        if line:
+            print(f"[bench telemetry | {label}] {line}", file=sys.stderr)
+    finally:
+        telemetry.disable()
+    return reports
+
+
+def _emit_report_artifact(payload, artifact_path, label):
     print(json.dumps(payload))
     try:
         os.makedirs(os.path.dirname(artifact_path), exist_ok=True)
         with open(artifact_path, "w") as f:
             json.dump(payload, f, indent=1)
     except OSError as e:  # pragma: no cover - diagnostics only
-        print(f"graph-report artifact write failed: {e}", file=sys.stderr)
+        print(f"{label} artifact write failed: {e}", file=sys.stderr)
+
+
+def sharding_report_main(artifact_path="artifacts/sharding_report_r09.json"):
+    """CPU-mesh sharding-observatory report (ISSUE 8): AOT-compile the
+    tiny synthetic serving apps (paged + cb) over a dp2 x tp2 CPU mesh,
+    census every collective in the partitioned HLO (kind x mesh-axis comm
+    group, payload bytes) and report the three-way
+    compute/memory/comm-bound roofline per graph under the assumed chip
+    constants (NXDI_TPU_PEAK_TFLOPS / NXDI_TPU_HBM_GBPS /
+    NXDI_TPU_ICI_GBPS / NXDI_TPU_DCN_GBPS). One parseable JSON line + an
+    artifact file, no TPU required: this is the hardware-free evidence
+    trail for collective regressions on the serving graphs —
+    `scripts/check_spmd_sharding.py` turns the same census into a red
+    test against `artifacts/spmd_golden.json`."""
+    from neuronx_distributed_inference_tpu.compat import force_cpu_devices
+    force_cpu_devices(4)
+
+    from neuronx_distributed_inference_tpu.telemetry import observatory
+
+    if len(jax.devices()) < 4:
+        print(json.dumps({
+            "metric": "sharding_report_collective_bytes_total",
+            "skipped": f"need 4 virtual CPU devices for the dp2xtp2 mesh, "
+                       f"got {len(jax.devices())} (backend initialized "
+                       "before the device-count flag could land)"}))
+        return
+
+    reports = _observatory_reports(mesh=True, label="sharding report")
+    total_bytes = sum(r["totals"]["collective_bytes"]
+                      for r in reports.values())
+    bounds = {f"{name}/{g['kind']}/{g['bucket']}": g["roofline"]["bound"]
+              for name, r in reports.items() for g in r["graphs"]}
+    payload = {
+        "metric": "sharding_report_collective_bytes_total",
+        "value": total_bytes,
+        "unit": "collective_payload_bytes_all_multichip_graphs",
+        "details": {
+            "schema": observatory.SHARDING_REPORT_SCHEMA,
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+            "mesh": reports["paged"]["mesh"],
+            "roofline_bounds": bounds,
+            "apps": reports,
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "sharding-report")
 
 
 def _no_tpu_fallback(error: str):
@@ -433,6 +489,18 @@ def _no_tpu_fallback(error: str):
             fn()
         except Exception as e:  # pragma: no cover - defensive
             extra[name + "_error"] = str(e)[:200]
+    # the sharding report needs a dp2xtp2 CPU mesh, but this process's
+    # backend is already initialized (the probe above) — possibly with a
+    # single device; re-exec so the virtual-device flag can land
+    try:
+        import subprocess
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sharding-report"], timeout=600)
+        if r.returncode != 0:
+            extra["sharding_report_error"] = f"rc {r.returncode}"
+    except Exception as e:  # pragma: no cover - defensive
+        extra["sharding_report_error"] = str(e)[:200]
     print(json.dumps({
         "skipped": "no TPU backend (decode throughput); CPU microbench "
                    "lines above",
@@ -463,6 +531,8 @@ def main():
         return serving_load_main()
     if "--graph-report" in sys.argv[1:]:
         return graph_report_main()
+    if "--sharding-report" in sys.argv[1:]:
+        return sharding_report_main()
     # probe the backend FIRST: on a machine with no TPU the bench must emit a
     # clearly-marked skip (one parseable JSON line, rc=0) — "no hardware" and
     # "regression" are different trajectories and must stay distinguishable.

@@ -48,6 +48,13 @@ GRAPH_FLOPS = "nxdi_graph_flops"                        # kind, bucket
 GRAPH_BYTES = "nxdi_graph_bytes"                        # kind, bucket
 GRAPH_PEAK_BYTES = "nxdi_graph_peak_bytes"              # kind, bucket
 
+# -- sharding observatory: SPMD collective census ----------------------------
+# kind here = COLLECTIVE kind (all_reduce|all_gather|reduce_scatter|
+# collective_permute|all_to_all); comm = mesh-axis subset ("tp", "dp",
+# "ep+tp", …) the replica groups ride
+GRAPH_COLLECTIVES_TOTAL = "nxdi_graph_collectives_total"    # kind, comm
+GRAPH_COLLECTIVE_BYTES = "nxdi_graph_collective_bytes"      # kind, comm
+
 # -- application hot paths (models/application.py) --------------------------
 # kind: prefill|decode|decode_loop|paged ; part: host|device
 RUN_SECONDS = "nxdi_run_seconds"
@@ -246,6 +253,24 @@ def graph_peak_bytes_gauge(reg):
         "XLA memory_analysis peak bytes (arguments + outputs + temps) of "
         "one compiled (kind, bucket) graph",
         labels=("kind", "bucket"))
+
+
+def graph_collectives_gauge(reg):
+    return reg.gauge(
+        GRAPH_COLLECTIVES_TOTAL,
+        "Collective ops censused across an app's partitioned (post-SPMD) "
+        "graphs; kind=all_reduce|all_gather|reduce_scatter|"
+        "collective_permute|all_to_all, comm=the mesh-axis subset the "
+        "replica groups ride (static census — loop bodies count once)",
+        labels=("kind", "comm"))
+
+
+def graph_collective_bytes_gauge(reg):
+    return reg.gauge(
+        GRAPH_COLLECTIVE_BYTES,
+        "Result-tensor payload bytes of the censused collectives "
+        "(summed over an app's graph set per kind x comm)",
+        labels=("kind", "comm"))
 
 
 def run_seconds_histogram(reg):

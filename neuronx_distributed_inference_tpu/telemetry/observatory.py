@@ -32,13 +32,45 @@ Compiling through fresh ``jax.jit`` wrappers keeps the application's own
 jit cache keys untouched — running the observatory can never change what
 the serving path executes (the XLA persistent compile cache still
 deduplicates the work).
+
+Sharding observatory (multichip census)
+---------------------------------------
+When the application's mesh spans more than one device the same AOT
+compile yields the **post-SPMD partitioned** HLO, and
+:func:`census_collectives` reads every collective out of it: op kind
+(all-reduce / all-gather / reduce-scatter / collective-permute /
+all-to-all), payload bytes, and the replica-group shape mapped back to
+the mesh axes the groups ride (``comm="tp"`` / ``"dp"`` / ``"ep+tp"`` /
+…). The census lands per graph in the report, in the
+``nxdi_graph_collectives_total`` / ``nxdi_graph_collective_bytes``
+gauges (labels ``kind``+``comm``), and in a third roofline leg: the
+estimated collective wire time under ``NXDI_TPU_ICI_GBPS`` (default 200
+GB/s — v5e ICI) and ``NXDI_TPU_DCN_GBPS`` (default 25 GB/s; ``dp``-axis
+collectives are priced at DCN, everything else at ICI), upgrading the
+per-graph verdict to compute- vs memory- vs **comm**-bound — the regime
+EQuARX (PAPERS.md arxiv 2506.17615) shows dominates DCN-scale decode.
+
+Collectives censused inside a ``while``/``scan`` body are counted once
+(static census, not dynamic executions). On a single-device mesh the
+census doubles as a guard: the unsharded graphs must contain ZERO
+collectives (an accidental ``shard_map``/``psum`` leaking into the
+1-device path raises here instead of silently running).
+
+``scripts/check_spmd_sharding.py`` builds on this census as a tier-1
+lint: it compiles a pinned multichip graph set, fails on the SPMD
+partitioner's involuntary-full-rematerialization pattern, and diffs the
+census against the committed golden (``artifacts/spmd_golden.json``).
 """
 
 from __future__ import annotations
 
+import contextlib
 import os
+import re
+import sys
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -46,9 +78,308 @@ import numpy as np
 from . import metrics as tmetrics
 from .registry import get_registry
 
-__all__ = ["analyze_app", "GRAPH_REPORT_SCHEMA"]
+__all__ = ["analyze_app", "census_collectives", "aggregate_census",
+           "comm_roofline_seconds", "mesh_comm_labels",
+           "capture_compiler_stderr", "REMAT_WARNING_RE", "SPMD_CHANNEL_RE",
+           "GRAPH_REPORT_SCHEMA", "SHARDING_REPORT_SCHEMA",
+           "COLLECTIVE_KINDS"]
 
 GRAPH_REPORT_SCHEMA = "nxdi-graph-report-v1"
+SHARDING_REPORT_SCHEMA = "nxdi-sharding-report-v1"
+
+# ---------------------------------------------------------------------------
+# SPMD partitioner warning channel (shared by __graft_entry__'s multichip
+# runner and scripts/check_spmd_sharding.py — one copy of the spellings)
+# ---------------------------------------------------------------------------
+
+# the partitioner's replicate-then-partition last resort, spelled
+# differently across XLA builds (older W-lines: "[SPMD] Involuntary full
+# rematerialization. ... SPMD will replicate the tensor"; newer E-lines:
+# "[spmd] Involuntary full rematerialization. The compiler was not able
+# to go from sharding ...") — match the stable core phrase
+REMAT_WARNING_RE = re.compile(r"involuntary full rematerialization", re.I)
+SPMD_CHANNEL_RE = re.compile(r"\[spmd\]", re.I)
+
+
+@contextlib.contextmanager
+def capture_compiler_stderr(counts: Optional[Dict[str, int]] = None,
+                            tee: bool = True):
+    """Capture everything written to fd 2 (Python AND C++ — the SPMD
+    partitioner logs through glog) around a compile. Yields a one-element
+    list holding the captured text after exit. With ``tee``, bytes are
+    written THROUGH to the real stderr as they arrive (a pump thread off
+    a pipe) — a hard kill mid-compile loses the counts but not the live
+    warning tail the multichip runner's log used to stream. With
+    ``counts``, accumulates ``spmd_warnings`` (all [SPMD] channel lines)
+    and ``involuntary_remat`` (the replicate-then-partition subset).
+    Degrades to a no-op when fd 2 is not a real descriptor."""
+    out: List[str] = [""]
+    # glog/XLA logs to LITERAL fd 2, not sys.stderr — which under test
+    # runners (pytest capture) is a temp-file wrapper on another fd
+    fd = 2
+    try:
+        saved = os.dup(fd)
+    except OSError:
+        yield out
+        return
+    read_fd, write_fd = os.pipe()
+    chunks: List[bytes] = []
+
+    def _pump():
+        while True:
+            try:
+                data = os.read(read_fd, 65536)
+            except OSError:
+                break
+            if not data:
+                break
+            chunks.append(data)
+            if tee:
+                try:
+                    os.write(saved, data)
+                except OSError:
+                    pass
+        os.close(read_fd)
+
+    pump = threading.Thread(target=_pump, daemon=True)
+    pump.start()
+    try:
+        sys.stderr.flush()
+        os.dup2(write_fd, fd)
+        yield out
+    finally:
+        sys.stderr.flush()
+        os.dup2(saved, fd)
+        os.close(write_fd)      # EOF to the pump (fd now points at saved)
+        pump.join(timeout=10.0)
+        if not pump.is_alive():
+            os.close(saved)
+        # else: pump stalled on a blocked downstream write — leak
+        # `saved` rather than free an fd number the thread still tees to
+        out[0] = b"".join(list(chunks)).decode("utf-8", "replace")
+        if counts is not None:
+            counts["involuntary_remat"] += len(
+                REMAT_WARNING_RE.findall(out[0]))
+            counts["spmd_warnings"] += sum(
+                1 for l in out[0].splitlines() if SPMD_CHANNEL_RE.search(l))
+
+
+# ---------------------------------------------------------------------------
+# HLO collective census
+# ---------------------------------------------------------------------------
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+# one HLO instruction line: "%name = <type> <op>(...), attr=..., ..."
+# (async pairs: count the -start, skip the -done — one wire transfer)
+_COLLECTIVE_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.-]+\s*=\s*(?P<type>\([^)]*\)|\S+)\s+"
+    r"(?P<op>" + "|".join(COLLECTIVE_KINDS) + r")(?P<suffix>-start|-done)?\(")
+
+# dtype tokens are arbitrary letter/digit runs (f32, bf16, f8e4m3b11fnuz)
+_SHAPE_RE = re.compile(r"\b([a-z][a-z0-9]*)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3fnuz": 1, "s4": 1, "u4": 1,
+}
+
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(?P<dims>[0-9,]+)\]<=\[(?P<reshape>[0-9,]+)\]"
+    r"(?:T\((?P<perm>[0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
+
+
+def _shape_bytes(type_str: str, async_start: bool = False) -> int:
+    """Byte size of an HLO result type. A sync tuple result (a variadic
+    combined collective) transfers EVERY element; an async ``-start``
+    tuple carries (operand..., result) where the earlier elements alias
+    inputs already counted — only the LAST element is the transferred
+    output."""
+    shapes = _SHAPE_RE.findall(type_str)
+    if not shapes:
+        return 0
+    if async_start:
+        # legacy 4-element permute-start tuples trail u32[] context
+        # scalars after the result — strip them before taking the last
+        while len(shapes) > 1 and shapes[-1][1] == "" and \
+                shapes[-1][0] in ("u32", "s32"):
+            shapes.pop()
+        shapes = shapes[-1:]
+    total = 0
+    for dt, dims in shapes:
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _parse_int_groups(body: str) -> List[Tuple[int, ...]]:
+    return [tuple(int(x) for x in grp.split(",") if x.strip())
+            for grp in re.findall(r"\{([0-9,\s]*)\}", body)]
+
+
+def _iota_groups(dims: Sequence[int], reshape: Sequence[int],
+                 perm: Optional[Sequence[int]]) -> List[Tuple[int, ...]]:
+    """Expand the V2 iota replica-group syntax
+    ``[g,s]<=[r...]T(p...)``: arange(prod) reshaped to ``r``, transposed
+    by ``p``, re-reshaped to ``g`` groups of ``s``."""
+    ids = np.arange(int(np.prod(reshape))).reshape(tuple(reshape))
+    if perm is not None:
+        ids = ids.transpose(tuple(perm))
+    ids = ids.reshape(tuple(dims))
+    return [tuple(int(x) for x in row) for row in ids]
+
+
+def _line_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        dims = [int(x) for x in m.group("dims").split(",")]
+        reshape = [int(x) for x in m.group("reshape").split(",")]
+        perm = ([int(x) for x in m.group("perm").split(",")]
+                if m.group("perm") else None)
+        return _iota_groups(dims, reshape, perm)
+    m = _EXPLICIT_GROUPS_RE.search(line)
+    if m:
+        return _parse_int_groups(m.group(1))
+    return None
+
+
+def mesh_comm_labels(mesh) -> Dict[frozenset, str]:
+    """Map replica-group *signatures* (frozenset of frozenset of LOGICAL
+    device indices — position in ``mesh.devices.flat``, which is the
+    device-assignment order the partitioned HLO numbers its partitions
+    in) to the mesh-axis subsets they ride, e.g. ``{{0,1},{2,3}} ->
+    "tp"`` on a dp2xtp2 mesh. Only axes with extent > 1 participate."""
+    shape = tuple(mesh.devices.shape)
+    names = tuple(mesh.axis_names)
+    logical = np.arange(int(np.prod(shape))).reshape(shape)
+    live = [i for i, s in enumerate(shape) if s > 1]
+    out: Dict[frozenset, str] = {}
+    for bits in range(1, 1 << len(live)):
+        subset = [live[i] for i in range(len(live)) if bits & (1 << i)]
+        rest = [i for i in range(len(shape)) if i not in subset]
+        grouped = logical.transpose(rest + subset).reshape(
+            -1, int(np.prod([shape[i] for i in subset])))
+        sig = frozenset(frozenset(int(x) for x in row) for row in grouped)
+        out.setdefault(sig, "+".join(names[i] for i in subset))
+    return out
+
+
+def _groups_label(groups: List[Tuple[int, ...]],
+                  labels: Optional[Dict[frozenset, str]]) -> str:
+    if labels is None:
+        return "unmapped"
+    sig = frozenset(frozenset(g) for g in groups)
+    return labels.get(sig, "other")
+
+
+def _pairs_label(pairs: List[Tuple[int, ...]],
+                 labels: Optional[Dict[frozenset, str]]) -> str:
+    """collective-permute has source→target pairs, not groups: the comm
+    axis is the smallest axis subset within whose groups every pair
+    stays (a tp-ring shift maps to "tp")."""
+    if labels is None:
+        return "unmapped"
+    if not pairs:
+        # unparseable/empty pairs would vacuously match EVERY subset —
+        # surface them as unmatched instead of mislabeling (and
+        # mispricing) the permute
+        return "other"
+    best = None
+    for sig, label in labels.items():
+        if all(any(s in grp and t in grp for grp in sig)
+               for s, t in pairs):
+            if best is None or len(label) < len(best):
+                best = label
+    return best or "other"
+
+
+def census_collectives(hlo_text: str, mesh=None) -> List[Dict[str, Any]]:
+    """Census every collective op in post-SPMD optimized HLO text.
+
+    Returns one entry per op occurrence: ``{"kind", "comm", "bytes",
+    "group_size"}`` where ``kind`` is the op with underscores
+    (``all_reduce``…), ``comm`` names the mesh-axis subset the replica
+    groups ride (via :func:`mesh_comm_labels`; ``"unmapped"`` without a
+    mesh, ``"other"`` when groups match no axis subset) and ``bytes`` is
+    the op's result-tensor payload. Async ``-start``/``-done`` pairs are
+    counted once (at the start)."""
+    labels = mesh_comm_labels(mesh) if mesh is not None else None
+    entries: List[Dict[str, Any]] = []
+    for line in hlo_text.splitlines():
+        m = _COLLECTIVE_LINE_RE.match(line)
+        if not m or m.group("suffix") == "-done":
+            continue
+        kind = m.group("op")
+        if kind == "collective-permute":
+            pm = _PAIRS_RE.search(line)
+            pairs = _parse_int_groups(pm.group(1)) if pm else []
+            comm = _pairs_label(pairs, labels)
+            group_size = 2
+        else:
+            groups = _line_groups(line) or []
+            comm = _groups_label(groups, labels) if groups else "other"
+            group_size = max((len(g) for g in groups), default=1)
+        entries.append({
+            "kind": kind.replace("-", "_"),
+            "comm": comm,
+            "bytes": _shape_bytes(m.group("type"),
+                                  m.group("suffix") == "-start"),
+            "group_size": group_size,
+        })
+    return entries
+
+
+def aggregate_census(entries: Sequence[Dict[str, Any]]
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Aggregate per-op census entries to ``{"kind@comm": {"count",
+    "bytes"}}`` — the shape the golden diff and the gauges key on."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for e in entries:
+        key = f"{e['kind']}@{e['comm']}"
+        slot = out.setdefault(key, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += e["bytes"]
+    return out
+
+
+# ring-model wire-byte factors per collective kind: how many times the
+# result tensor's bytes cross the wire per participating device
+# (g = replica-group size)
+def _wire_bytes(entry: Dict[str, Any]) -> float:
+    g = max(entry["group_size"], 2)
+    b = float(entry["bytes"])
+    k = entry["kind"]
+    if k == "all_reduce":            # reduce-scatter + all-gather ring
+        return 2.0 * (g - 1) / g * b
+    if k == "reduce_scatter":        # result is the 1/g shard
+        return (g - 1) * b
+    if k == "collective_permute":
+        return b
+    # all_gather / all_to_all: result is the full tensor
+    return (g - 1) / g * b
+
+
+def comm_roofline_seconds(entries: Sequence[Dict[str, Any]],
+                          ici_gbps: float, dcn_gbps: float) -> float:
+    """Estimated wire time of one invocation's collectives under the
+    assumed link bandwidths (GB/s). ``dp``-axis traffic — the outermost,
+    DCN-friendly mesh axis — is priced at DCN bandwidth; every other
+    axis (and unmapped/other groups) rides ICI."""
+    total = 0.0
+    for e in entries:
+        axes = set(e["comm"].split("+"))
+        bw = dcn_gbps if "dp" in axes else ici_gbps
+        if bw > 0:
+            total += _wire_bytes(e) / (bw * 1e9)
+    return total
 
 
 def _cost(compiled) -> Tuple[float, float]:
@@ -167,21 +498,42 @@ def _graph_entries(app) -> List[Tuple[str, str, Callable[[], Tuple]]]:
     return entries
 
 
+def _hlo_text(compiled) -> Optional[str]:
+    try:
+        return compiled.as_text()
+    except Exception:
+        return None
+
+
 def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
-                peak_tflops: Optional[float] = None) -> Dict[str, Any]:
+                peak_tflops: Optional[float] = None,
+                ici_gbps: Optional[float] = None,
+                dcn_gbps: Optional[float] = None) -> Dict[str, Any]:
     """AOT-compile every bucket-ladder graph of ``app`` and return the
     graph report (see module docstring). Gauges are recorded on
-    ``registry`` (default: the process-global one) when it is enabled."""
+    ``registry`` (default: the process-global one) when it is enabled.
+
+    On a multi-device mesh the partitioned HLO of each graph is censused
+    for collectives (per-graph ``collectives`` + the third roofline leg);
+    on a single-device mesh the census is a guard — any collective in an
+    unsharded graph raises RuntimeError."""
     reg = registry if registry is not None else get_registry()
     if hbm_gbps is None:
         hbm_gbps = float(os.environ.get("NXDI_TPU_HBM_GBPS", "819"))
     if peak_tflops is None:
         peak_tflops = float(os.environ.get("NXDI_TPU_PEAK_TFLOPS", "197"))
+    if ici_gbps is None:
+        ici_gbps = float(os.environ.get("NXDI_TPU_ICI_GBPS", "200"))
+    if dcn_gbps is None:
+        dcn_gbps = float(os.environ.get("NXDI_TPU_DCN_GBPS", "25"))
     if app.params is None:
         raise ValueError("load_weights() or init_random_weights() first")
     if app.cache is None:
         raise ValueError("init_cache() first")
+    mesh = app.mesh
+    n_mesh_devices = int(np.prod(mesh.devices.shape))
     graphs: List[Dict[str, Any]] = []
+    app_census: List[Dict[str, Any]] = []
     for kind, bucket, build in _graph_entries(app):
         fn, args, kwargs = build()
         t0 = time.perf_counter()
@@ -191,16 +543,35 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
         flops, bytes_acc = _cost(compiled)
         mem = _memory(compiled)
         peak = mem["peak_bytes"] if mem else 0
+        hlo = _hlo_text(compiled)
+        census = (census_collectives(hlo, mesh)
+                  if hlo is not None else None)
+        if census is not None and n_mesh_devices == 1 and census:
+            # single-device collective pin: an accidental shard_map/psum
+            # leaking into the unsharded path would silently tax every
+            # step — make it loud instead
+            raise RuntimeError(
+                f"single-device graph ({kind}, {bucket}) contains "
+                f"collectives: {aggregate_census(census)} — a "
+                "shard_map/psum leaked into the unsharded path")
+        coll_bytes = sum(e["bytes"] for e in census) if census else 0
         roofline = None
         if peak_tflops > 0 and hbm_gbps > 0:
             # a zero assumption means "unknown chip" — the static
             # flops/bytes/compile data is still valid without a roofline
             t_compute = flops / (peak_tflops * 1e12)
             t_memory = bytes_acc / (hbm_gbps * 1e9)
+            t_comm = (comm_roofline_seconds(census, ici_gbps, dcn_gbps)
+                      if census else 0.0)
+            legs = {"compute": t_compute, "memory": t_memory,
+                    "comm": t_comm}
+            bound = max(legs, key=legs.get)
             roofline = {
-                "est_step_ms": round(max(t_compute, t_memory) * 1e3, 6),
-                "bound": ("compute" if t_compute >= t_memory
-                          else "memory"),
+                "est_step_ms": round(max(legs.values()) * 1e3, 6),
+                "bound": bound,
+                "t_compute_ms": round(t_compute * 1e3, 6),
+                "t_memory_ms": round(t_memory * 1e3, 6),
+                "t_comm_ms": round(t_comm * 1e3, 6),
             }
         graph: Dict[str, Any] = {
             "kind": kind,
@@ -211,9 +582,15 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
             "memory": mem,
             "arithmetic_intensity": (round(flops / bytes_acc, 3)
                                      if bytes_acc else None),
+            "collectives": (aggregate_census(census)
+                            if census is not None else None),
+            "collective_count": len(census) if census is not None else None,
+            "collective_bytes": coll_bytes if census is not None else None,
             "roofline": roofline,
         }
         graphs.append(graph)
+        if census:
+            app_census.extend(census)
         if reg.enabled:
             tmetrics.compile_seconds_gauge(reg).set(compile_s, kind=kind,
                                                     bucket=bucket)
@@ -223,11 +600,25 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
                                                 bucket=bucket)
             tmetrics.graph_peak_bytes_gauge(reg).set(peak, kind=kind,
                                                      bucket=bucket)
+    if reg.enabled:
+        # collective census gauges aggregate over the app's whole graph
+        # set — kind here is the COLLECTIVE kind, comm the mesh-axis group
+        coll_g = tmetrics.graph_collectives_gauge(reg)
+        bytes_g = tmetrics.graph_collective_bytes_gauge(reg)
+        for key, slot in aggregate_census(app_census).items():
+            ckind, comm = key.split("@", 1)
+            coll_g.set(slot["count"], kind=ckind, comm=comm)
+            bytes_g.set(slot["bytes"], kind=ckind, comm=comm)
     return {
         "schema": GRAPH_REPORT_SCHEMA,
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
-        "assumptions": {"hbm_gbps": hbm_gbps, "peak_tflops": peak_tflops},
+        "mesh": {"devices": n_mesh_devices,
+                 "axes": {a: int(s) for a, s in
+                          zip(mesh.axis_names, mesh.devices.shape)
+                          if int(s) > 1}},
+        "assumptions": {"hbm_gbps": hbm_gbps, "peak_tflops": peak_tflops,
+                        "ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps},
         "graphs": graphs,
         "totals": {
             "graphs": len(graphs),
@@ -235,5 +626,7 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
                                          for g in graphs), 4),
             "flops": sum(g["flops"] for g in graphs),
             "bytes_accessed": sum(g["bytes_accessed"] for g in graphs),
+            "collectives": len(app_census),
+            "collective_bytes": sum(e["bytes"] for e in app_census),
         },
     }

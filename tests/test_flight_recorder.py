@@ -368,6 +368,10 @@ def test_graph_observatory_cpu(paged_app):
         assert g["memory"]["peak_bytes"] > 0
         assert g["arithmetic_intensity"] > 0
         assert g["roofline"]["bound"] in ("memory", "compute")
+        # single-device collective pin: the unsharded graphs census clean
+        # (a shard_map/psum leak would have raised inside analyze_app)
+        assert g["collectives"] == {} and g["collective_count"] == 0
+        assert g["roofline"]["t_comm_ms"] == 0.0
     json.dumps(report)                              # artifact-ready
     # gauges landed (the bench heartbeat's cold-start signal)
     assert reg.get(tmetrics.COMPILE_SECONDS).get(
