@@ -1096,6 +1096,68 @@ class PagedCausalLMApplication(CausalLMApplication):
             return self._compiled[key]
         return super().get_compiled(tag, bucket)
 
+    # -- speculative serving graphs (serving/speculation/) -----------------
+    def _jit_spec_draft(self, num_steps: int):
+        fn = partial(model_base.paged_spec_draft_loop, self.spec,
+                     self.tpu_config, num_steps=num_steps)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _jit_spec_verify(self, want_hidden: bool):
+        fn = partial(model_base.paged_spec_verify, self.spec,
+                     self.tpu_config, want_hidden=want_hidden)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _run_spec_draft(self, first_tokens, positions, block_table, widths,
+                        num_steps: int, sampling_params=None):
+        """Masked greedy-k self-draft pass (one fused dispatch; see
+        model_base.paged_spec_draft_loop). Frozen rows (width already
+        reached) write nothing, so the per-row clamp in ``widths`` bounds
+        every KV write."""
+        self._check_decode_fits(
+            int(np.max(np.asarray(positions) + np.asarray(widths) - 1)))
+        t0 = self._tel_start()
+        key = ("spec_draft", num_steps)
+        if key not in self._compiled:
+            self._compiled[key] = self._jit_spec_draft(num_steps)
+        self._note_jit("spec_draft", num_steps,
+                       (first_tokens.shape[0], block_table.shape[1]))
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(
+                first_tokens.shape[0])
+        with self._mesh_ctx():
+            out = self._compiled[key](
+                self.params, self.cache, jnp.asarray(first_tokens),
+                jnp.asarray(positions), jnp.asarray(block_table),
+                jnp.asarray(widths), sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        self._tel_end("spec_draft", t0, out,
+                      first_tokens.shape[0] * num_steps)
+        return out
+
+    def _run_spec_verify(self, input_ids, position_ids, slot_mapping,
+                         block_table, widths, want_hidden: bool = False):
+        """Speculative verify dispatch: ONE ragged k+1-wide paged forward
+        with in-graph greedy acceptance (model_base.paged_spec_verify).
+        ``input_ids`` may be a device array — drafts never round-trip
+        through the host."""
+        self._check_decode_fits(
+            int(np.max(np.asarray(position_ids)[:, 0]
+                       + np.asarray(widths))))
+        t0 = self._tel_start()
+        key = ("spec_verify", input_ids.shape[1], want_hidden)
+        if key not in self._compiled:
+            self._compiled[key] = self._jit_spec_verify(want_hidden)
+        self._note_jit("spec_verify", input_ids.shape[1],
+                       (input_ids.shape, block_table.shape))
+        with self._mesh_ctx():
+            out = self._compiled[key](
+                self.params, self.cache, jnp.asarray(input_ids),
+                jnp.asarray(position_ids), jnp.asarray(slot_mapping),
+                jnp.asarray(block_table), jnp.asarray(widths))
+        self.cache = out["cache"]
+        self._tel_end("spec_verify", t0, out, input_ids.shape[0])
+        return out
+
     def _bt_width(self, b: int) -> int:
         """Smallest block-table width bucket covering every live row's
         blocks (2-D prefix x prefill bucket selection)."""

@@ -1993,6 +1993,116 @@ def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
 
 
+def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
+                          cache, first_tokens, position_ids, block_table,
+                          widths, sampling_params, rng, num_steps: int):
+    """Masked greedy-k SELF-DRAFT loop over the paged cache — the
+    always-available proposer of speculative serving (serving/speculation/):
+    the target model drafts its own continuation through ``num_steps``
+    fused T=1 paged steps, exactly :func:`paged_decode_loop` except each
+    row stops drafting once it has contributed its per-row candidate
+    width (``widths`` (B,) = drafts + 1; rows clamped by seq_len or a
+    token budget draft fewer).
+
+    A frozen row's step writes nothing (slot -1 → dropped) and keeps its
+    token/position carry, so a ragged draft batch can never write KV past
+    a short row's grown block table. Draft KV lands at positions
+    [p, p+width-2]; the verify dispatch rewrites the same slots with the
+    same values (same model, same inputs), so the double write is
+    value-identical.
+
+    first_tokens (B,); position_ids (B,); block_table (B, max_blocks);
+    widths (B,) int32. Returns tokens (B, num_steps) + cache.
+    """
+    bs = cache["k"].shape[2]                  # paged (L, N, Bs, H, D)
+    b = first_tokens.shape[0]
+    rows = jnp.arange(b)
+
+    def step(carry, xs):
+        j, step_rng = xs
+        tok, pos, cch = carry
+        valid = j < widths - 1
+        safe = jnp.where(valid, pos, 0)
+        slot = jnp.where(valid,
+                         block_table[rows, safe // bs] * bs + safe % bs,
+                         -1)
+        out = paged_forward_step(
+            spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
+            pos[:, None], slot[:, None], block_table,
+            jnp.zeros((b,), jnp.int32), sampling_params, step_rng)
+        ntok = jnp.where(valid, out["tokens"], tok)
+        npos = jnp.where(valid, pos + 1, pos)
+        return (ntok, npos, out["cache"]), ntok
+
+    rngs = jax.random.split(rng, num_steps)
+    (_, _, new_cache), toks = jax.lax.scan(
+        step, (first_tokens, position_ids, cache),
+        (jnp.arange(num_steps), rngs))
+    return {"tokens": jnp.transpose(toks, (1, 0)), "cache": new_cache}
+
+
+def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                      input_ids, position_ids, slot_mapping, block_table,
+                      widths, want_hidden: bool = False):
+    """Speculative VERIFY graph over the paged layout: score all candidate
+    positions in ONE ragged multi-token dispatch and compute greedy
+    acceptance in-graph (reference acceptance: the cumsum-of-mismatch
+    trick, model_base.py:2726-2730; dispatch shape: the same ragged
+    per-row-width paged rows as chunked prefill — "Ragged Paged
+    Attention", arxiv 2604.15464).
+
+    input_ids (B, W): column 0 is each row's last ACCEPTED token, columns
+    1..W-1 its draft tokens (drafts may live on device — they never need
+    a host round trip). position_ids (B, W) absolute; slot_mapping (B, W)
+    with columns >= the row's width at -1 (dropped writes); widths (B,)
+    per-row candidate counts in [1, W].
+
+    Greedy exact-match acceptance: draft j is accepted iff it equals the
+    target's greedy choice at the previous candidate position; one bonus
+    token (the target's correction at the first mismatch) is always
+    emitted, so ``num_emitted`` is in [1, width]. The emitted tokens ARE
+    the target's greedy choices at consecutive positions — identical to
+    what eager decode would produce, whatever the draft quality.
+
+    Returns tokens (B, W) (emitted prefix, 0 past ``num_emitted``),
+    num_emitted (B,), cache (+ hidden (B, W, H) when ``want_hidden`` —
+    Medusa/EAGLE proposers feed on the verified features).
+    """
+    if spec.mixed_kv or spec.ssm is not None:
+        raise NotImplementedError(
+            "speculative verify over mixed per-layer / recurrent caches is "
+            "not supported; disable speculation for this model")
+    kv_len = block_table.shape[1] * cache["k"].shape[2]
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
+        position_ids, kv_len, window=w, chunk=c))
+    hidden = _embed(spec, params, input_ids, position_ids)
+    hidden, new_cache, _ = run_layers(
+        spec, params, cache, hidden, ai, None, position_ids,
+        "paged", slot_mapping=slot_mapping, block_table=block_table)
+    logits = _lm_head(spec, params, hidden)
+    # the same greedy the eager paged step applies (sampling_ops.sample
+    # over the untruncated head output) — bit-identity depends on it
+    greedy = sampling_ops.sample(logits, None, None, None)      # (B, W)
+    b, w = input_ids.shape
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    if w > 1:
+        # draft j (column j+1) must match the greedy choice at column j;
+        # columns past the row's width are forced mismatches so a padded
+        # row can never accept into its neighbour's padding
+        mismatch = ((input_ids[:, 1:] != greedy[:, :-1])
+                    | (idx[:, 1:] >= widths[:, None])).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)
+    else:
+        n_acc = jnp.zeros((b,), jnp.int32)
+    # accepted drafts equal the greedy choices by construction, so the
+    # emitted prefix is simply greedy[:, :n_acc+1] (bonus included)
+    tokens = jnp.where(idx <= n_acc[:, None], greedy, 0)
+    out = {"tokens": tokens, "num_emitted": n_acc + 1, "cache": new_cache}
+    if want_hidden:
+        out["hidden"] = hidden
+    return out
+
+
 def replace_output_logits(cfg: TpuConfig) -> TpuConfig:
     """decode_loop never returns per-step logits. Called at trace time only,
     so a plain copy per call is fine."""

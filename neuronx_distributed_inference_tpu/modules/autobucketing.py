@@ -98,6 +98,19 @@ def prefill_chunk_buckets(ctx_buckets: List[int],
     return [b for b in ctx_buckets if b <= cap]
 
 
+def spec_width_buckets(max_width: int) -> List[int]:
+    """Verify-width ladder for speculative serving dispatches
+    (serving/speculation/): per-row candidate widths (accepted-token root
+    + drafts, clamped by seq_len headroom and token budgets) pad to the
+    smallest bucket, so the k+1-wide verify graph and its matching draft
+    loop only ever compile a bounded set of shapes. ``max_width`` =
+    speculation k + 1; the ladder always starts at 1 (a fully clamped
+    batch degenerates to an eager decode step through the same graph)."""
+    if max_width < 1:
+        raise ValueError(f"spec width must be >= 1, got {max_width}")
+    return generate_buckets(1, max_width)
+
+
 def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:
     """Paged-app block-table width ladder (reference: 2-D prefix x prefill
     buckets, autobucketing.py:22-64 + selection model_wrapper.py:923-1045):

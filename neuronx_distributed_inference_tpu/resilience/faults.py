@@ -33,6 +33,15 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      asynchronous device failure from the PREVIOUS dispatch
                      would surface, so lookahead rollback is testable
                      deterministically
+  ``spec_draft``     the draft pass of a speculative serving step
+                     (serving/speculation/) — fires AFTER per-row KV
+                     growth, so draft-failure rollback (blocks shrunk,
+                     positions untouched) is provable
+  ``spec_verify``    the batched k+1-token verify dispatch of a
+                     speculative step — fires after the draft pass wrote
+                     its KV, so mid-verify failure must roll EVERY packed
+                     row back to its last accepted token (no
+                     half-accepted cache poisoning)
 
 Hot-path cost while nothing is armed: a single attribute check
 (``FAULTS.active``) — no call, no allocation (pinned by
@@ -49,7 +58,8 @@ from .errors import CapacityError
 __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 
 FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
-                "decode_step", "slow_step", "pipeline_flush")
+                "decode_step", "slow_step", "pipeline_flush",
+                "spec_draft", "spec_verify")
 
 
 class InjectedFault(RuntimeError):

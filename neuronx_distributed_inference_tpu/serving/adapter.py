@@ -277,6 +277,36 @@ class _AdapterTelemetry:
                 info["t_last"] = now
         self._rows(reg, "decode", n, padded, steps=steps)
 
+    def on_spec_step(self, rows: Sequence[Tuple[int, int]], t0: float,
+                     padded: int, width: int, drafted: int, accepted: int):
+        """One speculative engine step: ``rows`` is (seq_id, tokens
+        delivered) per live row — per-request TPOT counts every delivered
+        token, and the spec counters pin the drafted/accepted split."""
+        reg = self.registry
+        now = time.perf_counter()
+        delivered = 0
+        for sid, n in rows:
+            delivered += n
+            info = self._requests.get(sid)
+            if info is not None:
+                info["steps"] += n
+                info["t_last"] = now
+        if not reg.enabled:
+            return
+        tmetrics.decode_step_histogram(reg).observe(now - t0,
+                                                    engine=self.engine)
+        tmetrics.generated_tokens_counter(reg).inc(delivered,
+                                                   engine=self.engine)
+        tmetrics.spec_drafted_counter(reg).inc(drafted, engine=self.engine)
+        tmetrics.spec_accepted_counter(reg).inc(accepted,
+                                                engine=self.engine)
+        if drafted:
+            tmetrics.spec_accept_rate_gauge(reg).set(accepted / drafted,
+                                                     engine=self.engine)
+        tmetrics.spec_verify_width_histogram(reg).observe(
+            width, engine=self.engine)
+        self._rows(reg, "decode", len(rows), padded)
+
     def on_dispatch(self, depth: int):
         reg = self.registry
         if reg.enabled:
@@ -586,6 +616,7 @@ class _EngineAdapterBase:
         self._inflight: Optional[_Inflight] = None
         self._ready: Dict[int, int] = {}
         self._scratch = None
+        self._spec = None              # SpeculativeDecodePath (paged only)
         # plain-int host counters (always on — they feed the CPU
         # microbenches, bench.py --host-overhead / --prefill-overhead).
         # The decode counters (dispatches/blocking_fetches/...) count ONLY
@@ -1181,7 +1212,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
                  preemption_policy: Optional[str] = "lifo",
                  pipeline_depth: int = 0,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefill_budget_tokens: Optional[int] = None):
+                 prefill_budget_tokens: Optional[int] = None,
+                 speculation=None):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -1215,6 +1247,12 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self._chunks: Dict[int, _ChunkState] = {}   # pending admissions
         self._unwritten: set = set()   # allocated blocks not fully written
         self._init_decode_path(pipeline_depth)
+        if speculation is not None:
+            # deferred import: speculation/ imports this module
+            from .speculation import SelfDraftProposer, SpeculativeDecodePath
+            if isinstance(speculation, int):
+                speculation = SelfDraftProposer(speculation)
+            self._spec = SpeculativeDecodePath(self, speculation)
 
     def add_requests(self, seq_ids: Sequence[int],
                      prompts: Sequence[Sequence[int]],
@@ -1338,6 +1376,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
     def release(self, seq_ids: Sequence[int]):
         if self._inflight is not None:
             self._stash_flush()
+        if self._spec is not None:
+            self._spec.proposer.forget(seq_ids)
         for sid in seq_ids:
             self._ready.pop(sid, None)
             if sid in self._chunks:
@@ -1351,6 +1391,55 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 if sid in self.app.kv_mgr.tables:
                     self.app.kv_mgr.end_sequence(sid)
         self.telemetry.on_release(seq_ids)
+
+    # -- speculative decode (serving/speculation/) -------------------------
+    def step(self, seq_ids: Optional[Sequence[int]] = None,
+             token_room: Optional[Dict[int, int]] = None):
+        """Non-speculative adapters: one decode step, {seq_id: token}
+        (see the base class). With ``speculation=`` attached the step is
+        draft-and-verify and returns {seq_id: [tokens]} with 1..k+1
+        tokens per row; ``token_room`` (scheduler hook) caps each row's
+        tokens-delivered for this step."""
+        if self._spec is not None:
+            return self._spec.step(seq_ids, token_room)
+        if token_room is not None:
+            raise ConfigurationError(
+                "token_room is a speculative-decode hook; build the "
+                "adapter with speculation= to use it")
+        return super().step(seq_ids)
+
+    def step_many(self, num_steps: int,
+                  seq_ids: Optional[Sequence[int]] = None
+                  ) -> Dict[int, List[int]]:
+        """Fused multi-step decode (base class). With ``speculation=``
+        attached, ``num_steps`` becomes a per-row TOKEN budget: the path
+        runs speculative steps — each one verify dispatch — until every
+        row has delivered its budget (rows with high accept rates finish
+        in fewer dispatches; no row ever overshoots)."""
+        if self._spec is None:
+            return super().step_many(num_steps, seq_ids)
+        if num_steps < 1:
+            raise ConfigurationError("step_many requires num_steps >= 1")
+        out: Dict[int, List[int]] = {}
+        remaining: Dict[int, int] = {}
+        targets = seq_ids                  # validated on the first pass only
+        for _ in range(num_steps):
+            live = _live_rows(self.seqs, targets, self._pending_ids())
+            if seq_ids is not None:
+                # rows preempted mid-loop must not fail later passes
+                targets = [s for s in seq_ids
+                           if s in self.seqs or s in self._chunks]
+            ids = [s for s in live if remaining.get(s, num_steps) > 0]
+            if not ids and not self._pending_ids():
+                break
+            room = {s: remaining.get(s, num_steps) for s in ids}
+            res = self._spec.step(ids, token_room=room)
+            if not res and not ids:
+                break                  # pending-only pass made no tokens
+            for s, toks in res.items():
+                out.setdefault(s, []).extend(toks)
+                remaining[s] = remaining.get(s, num_steps) - len(toks)
+        return out
 
     # -- decode dispatch ---------------------------------------------------
     def _append_token(self, st: _SeqState, tok: int):
@@ -1544,6 +1633,10 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def _preempt(self, victim: int, reason: str):
         self._ready.pop(victim, None)      # replay regenerates it
+        if self._spec is not None:
+            # stateful proposers (Medusa/EAGLE) must not carry the
+            # victim's features into a re-admission under the same id
+            self._spec.proposer.forget((victim,))
         cst = self._chunks.pop(victim, None)
         if cst is not None:
             # half-prefilled victim: blocks not fully written must leave

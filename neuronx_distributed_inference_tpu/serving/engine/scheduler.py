@@ -415,6 +415,12 @@ class ServingEngine:
         alive = self.adapter.seqs
         eligible: List[int] = []
         horizon = self.decode_steps_per_pass
+        # speculative adapter: the pass budgets by TOKENS-DELIVERED, not
+        # steps — each row gets its remaining token budget as a per-row
+        # candidate-width clamp (decode_steps_per_pass > 1 caps it), and
+        # the pass stays one engine step = one verify dispatch
+        spec = getattr(self.adapter, "_spec", None)
+        room: Dict[int, int] = {}
         for sid, req in self._active.items():
             if sid not in alive and sid not in pending:
                 continue             # preempted, record not collected yet
@@ -424,13 +430,20 @@ class ServingEngine:
             if (self.max_unread_tokens is not None
                     and req.stream.unread >= self.max_unread_tokens):
                 continue               # backpressure: consumer is behind
-            horizon = min(horizon, self._room(sid, req))
+            r = self._room(sid, req)
+            if spec is not None:
+                room[sid] = (min(r, self.decode_steps_per_pass)
+                             if self.decode_steps_per_pass > 1 else r)
+            else:
+                horizon = min(horizon, r)
             eligible.append(sid)
         if not eligible:
             drained = self.adapter.flush()   # pipelined leftovers
             return self._route(drained if isinstance(drained, dict) else {})
         try:
-            if horizon > 1:
+            if spec is not None:
+                res = self.adapter.step(eligible, token_room=room)
+            elif horizon > 1:
                 res = self.adapter.step_many(horizon, eligible)
             else:
                 res = {s: [t] for s, t in

@@ -1,0 +1,41 @@
+"""Speculative continuous batching — draft-and-verify decode on the paged
+serving engine (ROADMAP item 4; see README "Speculative serving").
+
+The subsystem has two halves:
+
+* :mod:`.proposer` — the draft side. :class:`SelfDraftProposer` is the
+  always-available baseline (the target model greedily drafts its own
+  continuation through one fused masked loop);
+  :class:`PerturbedSelfDraftProposer` deterministically corrupts a draft
+  column (pinned partial-accept tests and chaos drills);
+  :class:`MedusaProposer` / :class:`EagleProposer` adapt the existing
+  non-serving proposers from ``models/speculation.py`` to the
+  continuous-batching world (per-sequence feature/draft-cache state,
+  eviction-aware).
+* :mod:`.verifier` — :class:`SpeculativeDecodePath`, the engine-step
+  machinery: per-row candidate widths padded within the
+  ``autobucketing.spec_width_buckets`` ladder, ONE batched k+1-token
+  verify dispatch per engine step with in-graph greedy acceptance, KV
+  grown for the draft window then shrunk to the accepted prefix, and
+  per-sequence accept cursors feeding variable tokens-per-step streams.
+
+Attach by constructing the adapter with ``speculation=``::
+
+    eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(k=3))
+    eng.add_requests([0], [prompt])
+    eng.step()        # -> {0: [t1, t2, t3, t4]} (accepted + bonus)
+
+Correctness never depends on the proposer: whatever it drafts, the
+delivered tokens are the target's own greedy choices (verified), so
+accepted-token streams are bit-identical to non-speculative decode —
+a bad proposer only costs acceptance rate, never output quality.
+"""
+
+from .proposer import (DraftProposer, EagleProposer, MedusaProposer,
+                       PerturbedSelfDraftProposer, SelfDraftProposer)
+from .verifier import SpeculativeDecodePath
+
+__all__ = [
+    "DraftProposer", "SelfDraftProposer", "PerturbedSelfDraftProposer",
+    "MedusaProposer", "EagleProposer", "SpeculativeDecodePath",
+]

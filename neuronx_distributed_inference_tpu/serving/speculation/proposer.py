@@ -1,0 +1,337 @@
+"""Draft proposers for speculative serving (see package docstring).
+
+A proposer's ONLY job is to guess the target model's next ``k`` greedy
+tokens per row; the verifier checks every guess against the target in one
+dispatch, so a proposer can never corrupt the output stream — accepted
+tokens are the target's own greedy choices whatever was drafted. Bad
+drafts cost acceptance rate (fewer tokens per verify dispatch), nothing
+else.
+
+The proposer contract is deliberately device-friendly: ``propose``
+returns the draft tokens as a DEVICE array and ``on_verify`` receives the
+verify graph's hidden features as a device array — drafts and features
+never round-trip through the host (``host_stats["blocking_fetches"]``
+counts exactly one sync per speculative step, the verify fetch).
+
+Proposers carrying per-sequence state (Medusa features, the EAGLE draft
+cache) key it by seq_id and drop it on :meth:`DraftProposer.forget` —
+the adapter calls it from release/preemption/rollback, so an evicted
+sequence can never poison a re-admission under the same id.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...resilience.errors import ConfigurationError
+
+__all__ = ["DraftProposer", "SelfDraftProposer",
+           "PerturbedSelfDraftProposer", "MedusaProposer", "EagleProposer"]
+
+
+class DraftProposer:
+    """Base proposer: ``max_drafts`` bounds the candidate width the
+    verifier budgets for (width = drafts + 1); ``wants_hidden`` asks the
+    verify graph to hand back its hidden features (Medusa/EAGLE feed on
+    them; the self-draft baseline keeps the graph lean without them)."""
+
+    name = "base"
+    wants_hidden = False
+
+    def __init__(self, k: int):
+        if k < 1:
+            raise ConfigurationError(
+                f"speculation needs k >= 1 draft tokens, got {k}")
+        self.max_drafts = int(k)
+
+    def bind(self, adapter) -> None:
+        """Called once when the adapter adopts this proposer."""
+
+    def propose(self, ctx):
+        """Draft up to ``ctx.num_drafts`` tokens per row. Returns a
+        (padded_batch, ctx.num_drafts) int32 array (device arrays
+        welcome), or None to skip drafting entirely (the step degenerates
+        to an eager-equivalent width-1 verify)."""
+        raise NotImplementedError
+
+    def on_verify(self, ctx, tokens: np.ndarray, n_emit: np.ndarray,
+                  hidden) -> None:
+        """Post-verify feedback: ``tokens``/``n_emit`` are the fetched
+        accept results for the live rows, ``hidden`` the device features
+        (None unless ``wants_hidden``)."""
+
+    def forget(self, seq_ids: Sequence[int]) -> None:
+        """Drop per-sequence state (release / preemption / rollback)."""
+
+
+class SelfDraftProposer(DraftProposer):
+    """Greedy-k SELF-drafting — the always-available baseline: the target
+    model drafts its own continuation through one fused masked loop
+    (``model_base.paged_spec_draft_loop``), so no extra weights, no extra
+    memory, and (under greedy sampling) every draft matches the verify
+    graph's greedy choice — the accept rate is pinned at 1.0 and each
+    engine step delivers the full k+1 tokens for two dispatches."""
+
+    name = "self_draft"
+
+    def propose(self, ctx):
+        if ctx.num_drafts < 1:
+            return None
+        return ctx.path._dispatch_spec_draft(ctx)
+
+
+class PerturbedSelfDraftProposer(SelfDraftProposer):
+    """Self-draft with draft column ``corrupt_at`` deterministically
+    corrupted (+1 mod vocab): the corrupted draft can never equal the
+    target's greedy choice, so acceptance stops exactly there —
+    ``corrupt_at`` drafts accepted per full-width step, a FIXED partial
+    accept rate. This is the pinned <1.0 fixture the accept bookkeeping,
+    KV shrink and rejection paths are tested against (and a chaos drill:
+    a broken proposer must only cost throughput, never correctness)."""
+
+    name = "perturbed_self_draft"
+
+    def __init__(self, k: int, corrupt_at: int = 1):
+        super().__init__(k)
+        if not 0 <= corrupt_at < k:
+            raise ConfigurationError(
+                f"corrupt_at must be in [0, {k}), got {corrupt_at}")
+        self.corrupt_at = corrupt_at
+        self._vocab: Optional[int] = None
+
+    def bind(self, adapter) -> None:
+        self._vocab = adapter.app.spec.vocab_size
+
+    def propose(self, ctx):
+        drafts = super().propose(ctx)
+        if drafts is None or ctx.num_drafts <= self.corrupt_at:
+            return drafts
+        import jax.numpy as jnp
+        col = drafts[:, self.corrupt_at]
+        return jnp.asarray(drafts).at[:, self.corrupt_at].set(
+            (col + 1) % self._vocab)
+
+
+class MedusaProposer(DraftProposer):
+    """Serving adapter over the medusa heads of ``models/speculation.py``
+    (:func:`~...models.speculation.medusa_propose`, chain mode): head j
+    predicts the token j+2 positions past the feature's, so the chain
+    [head_0 .. head_{k-1}] drafted from the feature of position p-1 lines
+    up exactly with candidate columns 1..k at positions p+1..p+k.
+
+    Per-row features come from the verify graph itself (``wants_hidden``):
+    after each step the feature at the bonus position is stored per
+    seq_id. A row with no feature yet (fresh admission — the chunked
+    paged prefill exposes no hidden states) drafts nothing its first
+    step; the verify bonus token both advances it and seeds its feature.
+    """
+
+    name = "medusa"
+    wants_hidden = True
+
+    def __init__(self, k: int):
+        super().__init__(k)
+        self._feat: Dict[int, Any] = {}
+        self._propose_fn = None
+        self._hidden_size = 0
+
+    def bind(self, adapter) -> None:
+        import jax
+        from ...models.speculation import medusa_propose
+        spec = adapter.app.spec
+        if spec.medusa_heads < self.max_drafts:
+            raise ConfigurationError(
+                f"MedusaProposer(k={self.max_drafts}) needs >= k medusa "
+                f"heads; the target spec has {spec.medusa_heads}")
+        self._hidden_size = spec.hidden_size
+        self._params = adapter.app.params
+        self._propose_fn = jax.jit(partial(medusa_propose, spec),
+                                   static_argnames=("top_k",))
+
+    def propose(self, ctx):
+        if ctx.num_drafts < 1 or not any(s in self._feat
+                                         for s in ctx.live):
+            return None
+        return ctx.path._dispatch_propose(self, ctx)
+
+    def _propose_device(self, ctx):
+        """Device work of one medusa chain proposal (called through the
+        verifier's ``_dispatch_propose`` lint region)."""
+        import jax.numpy as jnp
+        zero = jnp.zeros((self._hidden_size,), jnp.float32)
+        feats = [self._feat.get(s, zero) for s in ctx.live]
+        feats += [feats[0]] * (ctx.padded_batch - len(feats))
+        props = self._propose_fn(self._params, jnp.stack(feats),
+                                 top_k=1)
+        return props[:, :ctx.num_drafts, 0]
+
+    def on_verify(self, ctx, tokens, n_emit, hidden) -> None:
+        import jax.numpy as jnp
+        # hidden is padded to the batch bucket; n_emit covers live rows
+        feat = jnp.take_along_axis(
+            hidden[:len(ctx.live)],
+            jnp.asarray(n_emit - 1)[:, None, None], axis=1)[:, 0]
+        for i, s in enumerate(ctx.live):
+            self._feat[s] = feat[i]
+
+    def forget(self, seq_ids: Sequence[int]) -> None:
+        for s in seq_ids:
+            self._feat.pop(s, None)
+
+
+class EagleProposer(DraftProposer):
+    """Serving adapter over the EAGLE draft of ``models/speculation.py``:
+    the chain rollout (:func:`~...models.speculation.eagle_propose_scored`
+    shape, greedy top-1) proposes from a small fused draft model whose
+    contiguous KV cache rows are keyed by a STABLE per-sequence slot
+    (seq_ids-addressed writes), and after every verify the draft cache is
+    refreshed with the verified (token, target-feature) pairs — the same
+    post-acceptance refresh the fused non-serving path runs.
+
+    Serving difference vs ``EagleDecoder``: the paged prefill path
+    exposes no prompt hidden states, so the draft cache is primed
+    INCREMENTALLY from the verified feature stream instead of from a
+    prefill pass — early drafts for a fresh row are uninformed (low
+    accept rate, never wrong output) and sharpen as verified context
+    accumulates. Rows are dropped from the slot map on ``forget``.
+    """
+
+    name = "eagle"
+    wants_hidden = True
+
+    def __init__(self, draft_spec, draft_params, k: int,
+                 input_norm: bool = False):
+        super().__init__(k)
+        self.draft_spec = draft_spec
+        self.draft_params = draft_params
+        self.input_norm = input_norm
+        self._slots: Dict[int, int] = {}
+        self._free: List[int] = []
+        self._feat: Dict[int, Any] = {}
+        self.draft_cache = None
+
+    def bind(self, adapter) -> None:
+        import dataclasses
+        import jax
+        from ...models.speculation import eagle_forward
+        from ...modules.kv_cache import KVCacheSpec, init_cache
+        app = adapter.app
+        cfg = app.tpu_config
+        self._seq_len = cfg.seq_len
+        self._hidden_size = self.draft_spec.hidden_size
+        self._free = list(range(adapter.batch))
+        self.draft_cache = init_cache(KVCacheSpec(
+            num_layers=self.draft_spec.num_layers,
+            batch_size=adapter.batch, max_seq_len=cfg.seq_len,
+            num_kv_heads=self.draft_spec.gqa.num_kv_heads,
+            head_dim=self.draft_spec.head_dim,
+            dtype=self.draft_spec.kv_dtype), app.mesh)
+        # seq_ids-addressed draft-cache rows: the target cfg is NOT
+        # continuous-batching (paged), so flip the flag on a copy — the
+        # draft cache must key rows by the stable slot, not batch order
+        draft_cfg = dataclasses.replace(cfg, is_continuous_batching=True)
+
+        def chain(params, cache, first, feat, pos, sids, widths,
+                  num_steps):
+            import jax.numpy as jnp
+            seq_len = cfg.seq_len
+
+            def dstep(carry, j):
+                tok, hid, p, cch = carry
+                # per-row width clamp: a finished row's draft-KV write is
+                # pushed past seq_len (dropped) and its carry frozen, so
+                # ragged widths never write outside a row's window
+                valid = j < widths - 1
+                wpos = jnp.where(valid, p, seq_len)
+                out = eagle_forward(self.draft_spec, draft_cfg, params,
+                                    cch, tok[:, None], hid[:, None, :],
+                                    wpos[:, None], sids, self.input_norm)
+                ntok = jnp.where(
+                    valid,
+                    jnp.argmax(out["logits"][:, -1, :],
+                               axis=-1).astype(jnp.int32), tok)
+                nhid = jnp.where(valid[:, None],
+                                 out["hidden"][:, -1, :], hid)
+                return (ntok, nhid, jnp.where(valid, p + 1, p),
+                        out["cache"]), ntok
+
+            (_, _, _, cch), toks = jax.lax.scan(
+                dstep, (first, feat, pos, cache),
+                jnp.arange(num_steps))
+            return jnp.transpose(toks, (1, 0)), cch
+
+        self._chain = jax.jit(chain, static_argnames=("num_steps",))
+        self._refresh = jax.jit(
+            partial(eagle_forward, self.draft_spec, draft_cfg,
+                    input_norm=self.input_norm), donate_argnums=(1,))
+
+    def _slot_of(self, sid: int) -> int:
+        if sid not in self._slots:
+            self._slots[sid] = self._free.pop()
+        return self._slots[sid]
+
+    def propose(self, ctx):
+        if ctx.num_drafts < 1:
+            return None
+        return ctx.path._dispatch_propose(self, ctx)
+
+    def _row_arrays(self, ctx):
+        import jax.numpy as jnp
+        zero = jnp.zeros((self._hidden_size,),
+                         self.draft_spec.dtype)
+        feats = [self._feat.get(s, zero) for s in ctx.live]
+        sids = [self._slot_of(s) for s in ctx.live]
+        pad = ctx.padded_batch - len(feats)
+        feats += [feats[0]] * pad
+        sids += [sids[0]] * pad
+        return jnp.stack(feats), np.asarray(sids, np.int32)
+
+    def _propose_device(self, ctx):
+        import jax.numpy as jnp
+        feats, sids = self._row_arrays(ctx)
+        toks, self.draft_cache = self._chain(
+            self.draft_params, self.draft_cache,
+            jnp.asarray(ctx.first), feats, jnp.asarray(ctx.positions),
+            jnp.asarray(sids), jnp.asarray(ctx.widths),
+            num_steps=ctx.num_drafts)
+        return toks
+
+    def on_verify(self, ctx, tokens, n_emit, hidden) -> None:
+        ctx.path._dispatch_eagle_refresh(self, ctx, hidden)
+        import jax.numpy as jnp
+        # hidden is padded to the batch bucket; n_emit covers live rows
+        feat = jnp.take_along_axis(
+            hidden[:len(ctx.live)],
+            jnp.asarray(n_emit - 1)[:, None, None], axis=1)[:, 0]
+        for i, s in enumerate(ctx.live):
+            self._feat[s] = feat[i]
+
+    def _refresh_device(self, ctx, hidden):
+        """Draft-cache refresh with the VERIFIED pairs: slot p+j gets
+        (candidate token at p+j, target feature at p+j-1); columns past
+        each row's width are pushed to seq_len so their writes drop."""
+        import jax.numpy as jnp
+        feats, sids = self._row_arrays(ctx)
+        cand = ctx.cand                                # (Bp, W) device
+        hid_seq = jnp.concatenate(
+            [feats[:, None, :].astype(hidden.dtype),
+             hidden[:, :-1, :]], axis=1) if cand.shape[1] > 1 \
+            else feats[:, None, :].astype(hidden.dtype)
+        w = cand.shape[1]
+        idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+        pos = jnp.asarray(ctx.positions)[:, None] + idx
+        pos = jnp.where(idx < jnp.asarray(ctx.widths)[:, None], pos,
+                        self._seq_len)
+        out = self._refresh(self.draft_params, self.draft_cache, cand,
+                            hid_seq, pos, jnp.asarray(sids))
+        self.draft_cache = out["cache"]
+
+    def forget(self, seq_ids: Sequence[int]) -> None:
+        for s in seq_ids:
+            self._feat.pop(s, None)
+            slot = self._slots.pop(s, None)
+            if slot is not None:
+                self._free.append(slot)

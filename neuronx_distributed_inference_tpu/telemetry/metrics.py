@@ -72,6 +72,12 @@ KV_BLOCKS_IN_USE = "nxdi_kv_blocks_in_use"
 KV_BLOCK_ALLOC_FAILURES_TOTAL = "nxdi_kv_block_alloc_failures_total"
 PREFIX_CACHE_HIT_TOKENS_TOTAL = "nxdi_prefix_cache_hit_tokens_total"
 
+# -- speculative serving (serving/speculation/) ------------------------------
+SPEC_DRAFTED_TOKENS_TOTAL = "nxdi_spec_drafted_tokens_total"     # engine
+SPEC_ACCEPTED_TOKENS_TOTAL = "nxdi_spec_accepted_tokens_total"   # engine
+SPEC_ACCEPT_RATE = "nxdi_spec_accept_rate"                       # engine
+SPEC_VERIFY_WIDTH = "nxdi_spec_verify_width"                     # engine
+
 # -- degradations -----------------------------------------------------------
 MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
     "nxdi_moe_tkg_local_quant_degraded_total"
@@ -333,6 +339,38 @@ def kv_alloc_failures_counter(reg):
 def prefix_hit_tokens_counter(reg):
     return reg.counter(PREFIX_CACHE_HIT_TOKENS_TOTAL,
                        "Prompt tokens served from the prefix cache")
+
+
+def spec_drafted_counter(reg):
+    return reg.counter(
+        SPEC_DRAFTED_TOKENS_TOTAL,
+        "Draft tokens proposed per speculative verify dispatch "
+        "(accepted + rejected; excludes the always-emitted bonus token)",
+        labels=("engine",))
+
+
+def spec_accepted_counter(reg):
+    return reg.counter(
+        SPEC_ACCEPTED_TOKENS_TOTAL,
+        "Draft tokens the verify dispatch accepted (the gap to "
+        "nxdi_spec_drafted_tokens_total is wasted draft work)",
+        labels=("engine",))
+
+
+def spec_accept_rate_gauge(reg):
+    return reg.gauge(
+        SPEC_ACCEPT_RATE,
+        "Per-step draft acceptance rate (accepted/drafted of the last "
+        "speculative engine step; 1.0 under greedy self-drafting)",
+        labels=("engine",))
+
+
+def spec_verify_width_histogram(reg):
+    return reg.histogram(
+        SPEC_VERIFY_WIDTH,
+        "Bucketed candidate width (drafts + 1) of each speculative verify "
+        "dispatch — width 1 means the step degenerated to eager decode",
+        labels=("engine",), buckets=(1, 2, 4, 8, 16, 32))
 
 
 def moe_tkg_degraded_counter(reg):

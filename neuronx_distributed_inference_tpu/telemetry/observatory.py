@@ -461,6 +461,17 @@ def _graph_entries(app) -> List[Tuple[str, str, Callable[[], Tuple]]]:
                           np.zeros((b,), np.int32),
                           np.zeros((b, width_bt), np.int32),
                           app._default_sampling_params(b), rng), {})))
+        # the speculative verify graph (serving/speculation/): the ragged
+        # k+1-wide dispatch at the default self-draft ladder top (k=3)
+        sw = 4
+        entries.append((
+            "spec_verify", f"W{sw}xb{b}",
+            lambda: (app._jit_spec_verify(False),
+                     (app.params, app.cache, np.zeros((b, sw), np.int32),
+                      np.zeros((b, sw), np.int32),
+                      np.full((b, sw), -1, np.int32),
+                      np.zeros((b, width_bt), np.int32),
+                      np.ones((b,), np.int32)), {})))
         return entries
 
     cb = cfg.ctx_batch_size

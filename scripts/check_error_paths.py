@@ -34,6 +34,9 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
     "neuronx_distributed_inference_tpu/serving/engine/streams.py",
     "neuronx_distributed_inference_tpu/serving/engine/frontend.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/__init__.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/proposer.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
     "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
 )
 

@@ -51,6 +51,7 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/adapter.py",
     "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
 )
 # region functions that MUST exist when linting the default set — a rename
 # must move coverage, not lose it
@@ -61,6 +62,11 @@ EXPECTED_REGIONS = {
     ),
     "neuronx_distributed_inference_tpu/serving/engine/scheduler.py": (
         "_dispatch_engine_pass",      # serving engine dispatch-driving loop
+    ),
+    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py": (
+        "_dispatch_spec_draft",       # speculative draft pass (self-draft)
+        "_dispatch_propose",          # proposer-side draft (Medusa/EAGLE)
+        "_dispatch_spec_verify",      # THE one verify dispatch per step
     ),
 }
 

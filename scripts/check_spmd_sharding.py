@@ -34,6 +34,9 @@ plumbing; all CPU-mesh compiles, no execution):
     step + fused decode loop on a mesh (VERDICT weak #6: first compiled
     coverage of the paged path on multi-device)
   * ``cb_decode_dp2tp2``  — continuous-batching decode step
+  * ``paged_spec_verify_dp2tp2`` — the speculative ragged k+1-wide
+    verify dispatch (serving/speculation/) at the default self-draft
+    ladder top (W=4)
 
 Usage::
 
@@ -231,6 +234,7 @@ PINNED: Dict[str, Any] = {
     "paged_decode_dp2tp2": lambda: _app_graph(True, "paged"),
     "paged_loop_dp2tp2": lambda: _app_graph(True, "paged_loop"),
     "cb_decode_dp2tp2": lambda: _app_graph(False, "decode"),
+    "paged_spec_verify_dp2tp2": lambda: _app_graph(True, "spec_verify"),
 }
 
 

@@ -448,7 +448,7 @@ def test_lints_cover_engine_package():
         [sys.executable, str(REPO / "scripts" / "check_error_paths.py")],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert "6 file(s)" in r.stdout
+    assert "9 file(s)" in r.stdout    # engine/ + serving/speculation/
     r = subprocess.run(
         [sys.executable, str(REPO / "scripts" / "check_host_sync.py"),
          "--list-regions"],
