@@ -470,6 +470,34 @@ def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
     _emit_report_artifact(payload, artifact_path, "graph-report")
 
 
+def lint_report_main(artifact_path="artifacts/lint_report_r10.json"):
+    """Static-analysis report (ISSUE 10): run every ``nxdi_lint`` pass
+    in-process (no jax, sub-second) and commit the ``nxdi-lint-v1``
+    artifact, so lint findings trend across rounds exactly like bench
+    numbers — a finding count going 0 -> N between rounds is a
+    regression trajectory, not a folklore code-review memory. One
+    parseable JSON line + the artifact file."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "scripts"))
+    import nxdi_lint
+    report = nxdi_lint.run()
+    # the artifact IS the driver's --json output (one schema at this
+    # path: nxdi-lint-v1), the heartbeat line is bench-parseable
+    try:
+        nxdi_lint.write_artifact(report, artifact_path)
+    except OSError as e:  # pragma: no cover - defensive
+        print(f"lint-report artifact write failed: {e}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "lint_findings_total",
+        "value": len(report.findings),
+        "unit": "findings_all_passes",
+        "details": {"schema": "nxdi-lint-v1", "artifact": artifact_path,
+                    "files": len(report.files),
+                    "suppressed": len(report.suppressed)},
+    }))
+    return 0 if not report.findings else 1
+
+
 def _observatory_reports(mesh, label):
     """Build the tiny paged + cb serving apps (on the dp2 x tp2 CPU mesh
     when ``mesh``) and run the compiled-graph observatory over both —
@@ -584,7 +612,8 @@ def _no_tpu_fallback(error: str):
                      ("prefill_overhead", prefill_overhead_main),
                      ("spec_overhead", spec_overhead_main),
                      ("serving_load", serving_load_main),
-                     ("graph_report", graph_report_main)):
+                     ("graph_report", graph_report_main),
+                     ("lint_report", lint_report_main)):
         try:
             fn()
         except Exception as e:  # pragma: no cover - defensive
@@ -635,6 +664,8 @@ def main():
         return graph_report_main()
     if "--sharding-report" in sys.argv[1:]:
         return sharding_report_main()
+    if "--lint-report" in sys.argv[1:]:
+        return lint_report_main()
     # probe the backend FIRST: on a machine with no TPU the bench must emit a
     # clearly-marked skip (one parseable JSON line, rc=0) — "no hardware" and
     # "regression" are different trajectories and must stay distinguishable.

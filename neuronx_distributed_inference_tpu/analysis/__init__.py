@@ -1,0 +1,123 @@
+"""nxdi-lint: the unified static-analysis framework.
+
+One shared AST walker (:mod:`.walker`), a :class:`~.registry.Pass`
+registry, per-line ``# nxdi-lint: disable=<pass>`` suppressions with an
+unused-suppression check, and a unified findings model with a
+``--json`` artifact (:mod:`.findings`, schema ``nxdi-lint-v1``). The
+passes encode the serving stack's hard-won invariants — typed error
+paths, host-sync dispatch regions, the metric-name contract, the SPMD
+golden pin, donation safety, scratch-buffer aliasing safety and
+recompile hazards — see README "Static analysis" for the catalog and
+the red-then-green methodology for adding one.
+
+STDLIB-ONLY by contract, and loadable WITHOUT the parent package: the
+driver (``scripts/nxdi_lint.py``) and the ``check_*.py`` back-compat
+shims import it via :data:`scripts.nxdi_lint.load_analysis` so a lint
+subprocess never pays the package's jax import.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .findings import SCHEMA, Finding, PassStats, Report  # noqa: F401
+from .registry import (LintContext, Pass, all_passes,  # noqa: F401
+                       get_pass)
+
+UNUSED_PASS = "unused-suppression"
+
+
+def _apply_suppressions(ctx: LintContext, findings: List[Finding],
+                        used) -> (list, list):
+    """Split findings into (surviving, suppressed), recording which
+    suppression comments fired in ``used`` (a set of
+    (rel, suppression-line) pairs)."""
+    by_rel = {sf.rel: sf for sf in ctx.scanned()}
+    kept: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        sf = by_rel.get(f.path)
+        hit = None
+        if sf is not None:
+            for sup in sf.suppressions:
+                if f.line in sup.covers and (f.pass_name in sup.passes
+                                             or "all" in sup.passes):
+                    hit = sup
+                    break
+        if hit is None:
+            kept.append(f)
+        else:
+            used.add((f.path, hit.line))
+            suppressed.append(f)
+    return kept, suppressed
+
+
+def run_single(ctx: LintContext, name: str,
+               paths: Optional[Sequence[str]] = None) -> List[Finding]:
+    """One pass, suppressions applied — the back-compat ``check_*.py``
+    shims route through this so a suppression honored by the driver is
+    honored by the legacy CLI too."""
+    raw = get_pass(name).run(ctx, paths=paths)
+    kept, _ = _apply_suppressions(ctx, raw, set())
+    return kept
+
+
+def run_passes(repo_root, names: Optional[Sequence[str]] = None,
+               ctx: Optional[LintContext] = None,
+               overrides: Optional[Dict[str, Sequence[str]]] = None
+               ) -> Report:
+    """Run the selected passes (default: all) in-process over one repo
+    root and return the unified :class:`Report` — suppressions applied,
+    unused suppressions reported as findings of the virtual
+    ``unused-suppression`` pass. ``overrides`` maps a pass name to an
+    explicit file list (tests / partial runs); unlisted passes keep
+    their default paths."""
+    registry = all_passes()
+    if names is None:
+        names = list(registry)
+    unknown = [n for n in names if n not in registry]
+    if unknown:
+        raise KeyError(f"unknown pass(es) {unknown}; "
+                       f"available: {list(registry)}")
+    ctx = ctx or LintContext(Path(repo_root))
+    report = Report()
+    used = set()
+    for name in names:
+        p = registry[name]
+        t0 = time.perf_counter()
+        pass_paths = (overrides or {}).get(name)
+        raw = p.run(ctx, paths=pass_paths)
+        kept, suppressed = _apply_suppressions(ctx, raw, used)
+        report.findings.extend(kept)
+        report.suppressed.extend(suppressed)
+        report.passes.append(PassStats(
+            name=p.name, description=p.description,
+            files=len(pass_paths if pass_paths is not None
+                      else p.default_paths),
+            findings=len(kept), suppressed=len(suppressed),
+            duration_s=time.perf_counter() - t0))
+    # unused-suppression check: every disable comment in a scanned file
+    # must have absorbed at least one finding of a named pass that ran
+    ran = set(names)
+    unused: List[Finding] = []
+    for sf in ctx.scanned():
+        for sup in sf.suppressions:
+            if (sf.rel, sup.line) in used:
+                continue
+            if not (set(sup.passes) & (ran | {"all"})):
+                continue           # suppresses only passes that didn't run
+            unused.append(Finding(
+                UNUSED_PASS, sf.rel, sup.line,
+                f"suppression for {', '.join(sup.passes)} did not match "
+                "any finding — stale comment (the code was fixed, or the "
+                "pass name is misspelled); remove it"))
+    report.findings.extend(unused)
+    report.passes.append(PassStats(
+        name=UNUSED_PASS,
+        description="every nxdi-lint disable comment still absorbs a "
+                    "finding",
+        files=len(ctx.scanned()), findings=len(unused)))
+    report.files = sorted(sf.rel for sf in ctx.scanned())
+    return report

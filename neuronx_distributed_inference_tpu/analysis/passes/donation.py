@@ -1,0 +1,164 @@
+"""donation-safety: never read a donated cache binding after the
+dispatch that consumed it.
+
+Every device graph in this codebase takes the KV cache with
+``jax.jit(..., donate_argnums=...)``: the moment the dispatch call is
+issued, the caller's array is CONSUMED — XLA may reuse its buffer for
+the output — and any subsequent host read of the old binding observes
+garbage (or raises a deleted-buffer error much later, on hardware only).
+This is the ``retry_safe=False`` state-loss class: the ``_run_*``
+helpers all rebind ``self.cache = out["cache"]`` on the very next line,
+and this pass makes that convention a checked contract.
+
+Per-function linear dataflow (statements flattened in source order, the
+documented approximation — loop back-edges are not modeled, which is
+safe here because every dispatch is followed by its rebind in straight
+line code):
+
+  * tracked bindings: attribute chains ending in ``.cache`` /
+    ``.draft_cache`` (``self.cache``, ``app.cache``, ...) and local
+    aliases assigned from a tracked chain (bare ``cache`` parameters
+    are functional values inside traced code, not host bindings);
+  * passing a tracked binding as a CALL ARGUMENT marks it consumed
+    (over-approximate by design: a helper that takes the cache without
+    donating it should be rare enough to earn an inline suppression
+    with a reason);
+  * a store to the binding (``self.cache = out["cache"]``, tuple
+    targets included) cleans it;
+  * any read while consumed is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..findings import Finding
+from ..registry import LintContext, Pass, register
+from ..walker import (assignment_targets, dotted, linear_statements,
+                      statement_expressions)
+
+DONATED_ATTRS = ("cache", "draft_cache")
+
+DEFAULT_PATHS = (
+    "neuronx_distributed_inference_tpu/models/application.py",
+    "neuronx_distributed_inference_tpu/models/speculation.py",
+    "neuronx_distributed_inference_tpu/serving/adapter.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/proposer.py",
+    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
+    "neuronx_distributed_inference_tpu/utils/host_loop.py",
+)
+
+
+def _tracked_chain(node: ast.AST) -> Optional[str]:
+    """The tracked binding key for an expression, if any: an ATTRIBUTE
+    chain whose last component is a donated attr (``self.cache``,
+    ``app.draft_cache``). Bare names are deliberately not tracked — a
+    ``cache`` parameter inside a traced/pure function is consumed
+    functionally (run_layers takes it and returns the new one), which is
+    not the host-layer donation contract; host code holds the donated
+    binding on an object, and local aliases of those chains are tracked
+    through the alias map."""
+    chain = dotted(node)
+    if chain is None or "." not in chain:
+        return None
+    last = chain.rsplit(".", 1)[-1]
+    return chain if last in DONATED_ATTRS else None
+
+
+class _FunctionFlow:
+    """Linear consumed/clean tracking for one function scope."""
+
+    def __init__(self, pass_name: str, rel: str, fn: ast.AST):
+        self.pass_name = pass_name
+        self.rel = rel
+        self.fn = fn
+        self.consumed: Dict[str, int] = {}     # binding -> consuming line
+        self.aliases: Dict[str, str] = {}      # local name -> chain
+        self.findings: List[Finding] = []
+
+    def _key(self, node: ast.AST) -> Optional[str]:
+        chain = _tracked_chain(node)
+        if chain is not None:
+            return chain
+        name = dotted(node)
+        return name if name in self.aliases else None
+
+    def run(self) -> List[Finding]:
+        for stmt in linear_statements(self.fn):
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            targets = assignment_targets(stmt)
+            target_ids = {id(t) for t in targets}
+            reads: List[ast.AST] = []
+            consumes: List[ast.AST] = []
+            for node in statement_expressions(stmt):
+                if isinstance(node, ast.Call):
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Starred):
+                            arg = arg.value
+                        if self._key(arg) is not None:
+                            consumes.append(arg)
+                key = self._key(node)
+                if key is not None and id(node) not in target_ids and \
+                        isinstance(getattr(node, "ctx", None), ast.Load):
+                    reads.append(node)
+            consume_ids = {id(c) for c in consumes}
+            # 1) reads first: a read that is itself the consuming
+            #    argument is legal when the binding was clean
+            for node in reads:
+                key = self._key(node)
+                dline = self.consumed.get(key)
+                if dline is not None and id(node) not in consume_ids:
+                    self.findings.append(Finding(
+                        self.pass_name, self.rel, node.lineno,
+                        f"read of donated binding {key!r} after the "
+                        f"dispatch on line {dline} consumed it "
+                        "(donate_argnums) — the old buffer is invalid; "
+                        "rebind it from the dispatch output first"))
+            for node in reads:
+                key = self._key(node)
+                if self.consumed.get(key) is not None and \
+                        id(node) in consume_ids:
+                    self.findings.append(Finding(
+                        self.pass_name, self.rel, node.lineno,
+                        f"donated binding {key!r} passed to another call "
+                        f"after the dispatch on line "
+                        f"{self.consumed[key]} consumed it — double "
+                        "consumption of a dead buffer"))
+            # 2) then mark consumption ...
+            for node in consumes:
+                key = self._key(node)
+                self.consumed.setdefault(key, node.lineno)
+            # 3) ... and let stores clean / create aliases
+            for tgt in targets:
+                key = self._key(tgt)
+                if key is not None:
+                    self.consumed.pop(key, None)
+                if isinstance(tgt, ast.Name) and isinstance(stmt, ast.Assign):
+                    chain = _tracked_chain(stmt.value)
+                    if chain is not None:
+                        self.aliases[tgt.id] = chain
+                    else:
+                        self.aliases.pop(tgt.id, None)
+        return self.findings
+
+
+@register
+class DonationSafetyPass(Pass):
+    name = "donation-safety"
+    description = ("no read of a donated cache binding after the "
+                   "dispatch that consumed it (donate_argnums "
+                   "state-loss class)")
+    default_paths = DEFAULT_PATHS
+
+    def run(self, ctx: LintContext,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in self._sources(ctx, paths, findings):
+            for info in sf.functions():
+                findings.extend(
+                    _FunctionFlow(self.name, sf.rel, info.node).run())
+        return findings

@@ -1,0 +1,100 @@
+"""metric-names: the metric-name contract and the README table cannot
+drift.
+
+Port of the PR-7 ``scripts/check_metric_names.py`` checker: every
+``nxdi_*`` string constant registered in ``telemetry/metrics.py`` must
+appear in the README "Observability" metric table, and every ``nxdi_*``
+name in that table must be a registered constant — symmetric, like the
+SPMD golden.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from ..findings import Finding
+from ..registry import LintContext, Pass, register
+
+METRICS_PATH = "neuronx_distributed_inference_tpu/telemetry/metrics.py"
+README_PATH = "README.md"
+
+_NAME_RE = re.compile(r"nxdi_[a-z0-9_]+")
+
+
+def registered_names(tree: ast.AST) -> Set[str]:
+    """``nxdi_*`` string constants assigned at module level in
+    telemetry/metrics.py — the canonical registration point."""
+    names: Set[str] = set()
+    for node in tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Constant) and isinstance(value.value, str)
+                and value.value.startswith("nxdi_")):
+            names.add(value.value)
+    return names
+
+
+def documented_names(readme_source: str) -> Set[str]:
+    """``nxdi_*`` names in the README Observability metric table (table
+    rows only — prose mentions elsewhere are cross-references, not
+    documentation of record)."""
+    lines = readme_source.splitlines()
+    try:
+        start = next(i for i, l in enumerate(lines)
+                     if l.strip() == "## Observability")
+    except StopIteration:
+        return set()
+    names: Set[str] = set()
+    for line in lines[start + 1:]:
+        if line.startswith("## "):
+            break
+        if line.lstrip().startswith("|"):
+            names.update(_NAME_RE.findall(line))
+    return names
+
+
+@register
+class MetricNamesPass(Pass):
+    name = "metric-names"
+    description = ("telemetry nxdi_* name constants and the README "
+                   "Observability table stay in sync, both directions")
+    default_paths = (METRICS_PATH, README_PATH)
+
+    def run(self, ctx: LintContext,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        metrics_rel, readme_rel = (paths if paths is not None
+                                   else self.default_paths)
+        findings: List[Finding] = []
+        metrics_sf = ctx.source_for(metrics_rel)
+        readme_sf = ctx.source_for(readme_rel)
+        if metrics_sf is None:
+            return [self.missing(str(metrics_rel))]
+        if readme_sf is None:
+            return [self.missing(str(readme_rel))]
+        if metrics_sf.tree is None:
+            return [Finding(self.name, metrics_sf.rel, 1,
+                            "not parseable as Python — wrong file?")]
+        registered = registered_names(metrics_sf.tree)
+        documented = documented_names(readme_sf.text)
+        if not registered:
+            return [Finding(self.name, metrics_sf.rel, 1,
+                            "no nxdi_* constants found — wrong file?")]
+        if not documented:
+            return [Finding(self.name, readme_sf.rel, 1,
+                            "no Observability metric table found — "
+                            "wrong file?")]
+        for nm in sorted(registered - documented):
+            findings.append(Finding(
+                self.name, readme_sf.rel, 1,
+                f"{nm} is registered in metrics.py but missing from the "
+                "README Observability table — document it (names are a "
+                "stable contract)"))
+        for nm in sorted(documented - registered):
+            findings.append(Finding(
+                self.name, readme_sf.rel, 1,
+                f"{nm} appears in the README Observability table but is "
+                "not registered in metrics.py — typo or leftover row"))
+        return findings

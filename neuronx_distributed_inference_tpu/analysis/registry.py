@@ -1,0 +1,103 @@
+"""Pass registry + the lint context every pass runs against.
+
+A pass subclasses :class:`Pass`, names itself (the name is the
+suppression token: ``# nxdi-lint: disable=<name>``), declares its
+default repo-relative file set and implements ``run(ctx, paths=None)``.
+Registration is a decorator; the driver discovers passes by importing
+:mod:`.passes`.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from .findings import Finding
+from .walker import SourceFile
+
+_REGISTRY: Dict[str, "Pass"] = {}
+
+
+class LintContext:
+    """Parse-once source cache over one repo root. ``source()`` returns
+    None for a missing file (passes emit their own missing-file
+    finding, mirroring the old checkers)."""
+
+    def __init__(self, repo_root: Path):
+        self.repo_root = Path(repo_root)
+        self._cache: Dict[str, Optional[SourceFile]] = {}
+
+    def source(self, rel: str) -> Optional[SourceFile]:
+        rel = Path(rel).as_posix()
+        if rel not in self._cache:
+            path = self.repo_root / rel
+            self._cache[rel] = (SourceFile(path.read_text(), rel)
+                                if path.exists() else None)
+        return self._cache[rel]
+
+    def source_for(self, path: Path) -> Optional[SourceFile]:
+        """Absolute or repo-relative path → SourceFile (repo-relative
+        when under the root, else keyed by its absolute posix path so
+        shims can lint arbitrary files)."""
+        p = Path(path)
+        if not p.is_absolute():
+            return self.source(p.as_posix())
+        try:
+            return self.source(p.relative_to(self.repo_root).as_posix())
+        except ValueError:
+            key = p.as_posix()
+            if key not in self._cache:
+                self._cache[key] = (SourceFile(p.read_text(), key)
+                                    if p.exists() else None)
+            return self._cache[key]
+
+    def scanned(self) -> List[SourceFile]:
+        return [sf for sf in self._cache.values() if sf is not None]
+
+
+class Pass:
+    """Base class: one static-analysis pass."""
+
+    name: str = ""
+    description: str = ""
+    default_paths: Sequence[str] = ()
+
+    def run(self, ctx: LintContext,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        raise NotImplementedError
+
+    # shared helper: resolve the file list, emitting missing-file findings
+    def _sources(self, ctx: LintContext, paths: Optional[Sequence[str]],
+                 findings: List[Finding]):
+        out = []
+        for rel in (paths if paths is not None else self.default_paths):
+            sf = ctx.source_for(Path(rel))
+            if sf is None:
+                findings.append(Finding(self.name, str(rel), 0,
+                                        "file is missing"))
+            elif sf.tree is None:
+                findings.append(Finding(
+                    self.name, sf.rel, 1,
+                    "not parseable as Python — this pass needs an AST"))
+            else:
+                out.append(sf)
+        return out
+
+    def missing(self, rel: str) -> Finding:
+        return Finding(self.name, rel, 0, "file is missing")
+
+
+def register(cls):
+    inst = cls()
+    assert inst.name and inst.name not in _REGISTRY, inst.name
+    _REGISTRY[inst.name] = inst
+    return cls
+
+
+def all_passes() -> Dict[str, Pass]:
+    from . import passes as _passes  # noqa: F401  (registration side effect)
+    return dict(sorted(_REGISTRY.items()))
+
+
+def get_pass(name: str) -> Pass:
+    return all_passes()[name]

@@ -1,0 +1,290 @@
+"""Shared AST walker for nxdi-lint passes.
+
+One :class:`SourceFile` per linted file: the module is parsed ONCE and
+every pass reads the same tree through the helpers here — function/class
+indexing with qualified names, dotted attribute-chain rendering, local
+alias tracking (``app = self.app`` making ``app._run_paged`` count as an
+``.app`` dispatch), numpy-import alias resolution, statement
+linearization for order-sensitive dataflow (donation/aliasing), and
+per-line ``# nxdi-lint: disable=<pass>`` suppression parsing.
+
+Everything in this package is STDLIB-ONLY by contract: the driver
+(``scripts/nxdi_lint.py``) and the back-compat ``check_*.py`` shims load
+it without importing the parent package (whose ``__init__`` pulls jax),
+so a lint subprocess costs milliseconds, not a jax import — that is what
+lets the whole suite run in-process inside the tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*nxdi-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One ``# nxdi-lint: disable=a,b`` comment. ``covers`` is the line
+    set it applies to: its own line, plus — when the comment stands on a
+    line of its own — the next code line below it."""
+    line: int
+    covers: Tuple[int, ...]
+    passes: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class FunctionInfo:
+    """One (possibly nested) function with its context."""
+    qualname: str
+    node: ast.AST                    # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str]        # nearest enclosing class, if any
+    parent: Optional[ast.AST]        # nearest enclosing function, if any
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render an attribute/name chain as ``"self.app.cache"``; None for
+    anything that is not a pure Name/Attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    """Dotted name of a call target (``"self.app._run_paged"``)."""
+    return dotted(call.func)
+
+
+def linear_statements(node: ast.AST) -> Iterator[ast.stmt]:
+    """Every statement under ``node`` in source order, with compound
+    statements (if/for/while/with/try) flattened — the linear
+    approximation the dataflow passes document. Nested function/class
+    bodies are NOT descended into (they are separate scopes, analyzed on
+    their own)."""
+    body: List[ast.stmt] = getattr(node, "body", [])
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            for sub in getattr(stmt, attr, []):
+                yield from _linear_one(sub)
+        for handler in getattr(stmt, "handlers", []):
+            for sub in handler.body:
+                yield from _linear_one(sub)
+
+
+def _linear_one(stmt: ast.stmt) -> Iterator[ast.stmt]:
+    yield stmt
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    for attr in ("body", "orelse", "finalbody"):
+        for sub in getattr(stmt, attr, []):
+            yield from _linear_one(sub)
+    for handler in getattr(stmt, "handlers", []):
+        for sub in handler.body:
+            yield from _linear_one(sub)
+
+
+def statement_expressions(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Expression nodes belonging to ONE statement, without descending
+    into child statements (compound statements contribute only their
+    header: an ``if`` its test, a ``for`` its target+iter, a ``with``
+    its items). Pair with :func:`linear_statements`, which yields the
+    child statements separately — walking the whole compound node would
+    process every nested expression twice."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: List[ast.AST] = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [it.context_expr for it in stmt.items] + \
+                [it.optional_vars for it in stmt.items
+                 if it.optional_vars is not None]
+    elif isinstance(stmt, ast.Try):
+        roots = [h.type for h in stmt.handlers if h.type is not None]
+    else:
+        roots = list(ast.iter_child_nodes(stmt))
+    for root in roots:
+        yield root
+        yield from walk_shallow(root)
+
+
+def walk_shallow(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does not descend into nested function/class
+    definitions — expression-level traversal of ONE scope."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+class SourceFile:
+    """One parsed source file shared by every pass."""
+
+    def __init__(self, text: str, rel: str):
+        self.text = text
+        self.rel = rel                       # repo-relative posix path
+        self.lines = text.splitlines()
+        # Parse ANYTHING that parses as Python — the old check_*.py CLIs
+        # accepted arbitrary user paths (a metrics file copied to .txt),
+        # and the extension is not the contract. Non-Python inputs
+        # (README.md) carry no tree; AST passes emit a finding for a
+        # treeless file instead of dereferencing it.
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(text)
+        except SyntaxError:
+            self.tree = None
+        self.suppressions: List[Suppression] = (
+            self._parse_suppressions() if self.tree is not None else [])
+        # memo caches — the recompile closure calls these per call site
+        self._toplevel: Optional[Dict[str, ast.AST]] = None
+        self._fn_index: Optional[Dict[str, ast.AST]] = None
+        self._mod_aliases: Dict[str, Set[str]] = {}
+        self._imported: Dict[str, Set[str]] = {}
+
+    # -- suppressions ------------------------------------------------------
+    def _parse_suppressions(self) -> List[Suppression]:
+        sups: List[Suppression] = []
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            passes = tuple(sorted({p.strip() for p in m.group(1).split(",")
+                                   if p.strip()}))
+            covers = [i]
+            if line.lstrip().startswith("#"):
+                # a standalone comment also covers the next code line
+                for j in range(i, len(self.lines)):
+                    nxt = self.lines[j].strip()
+                    if nxt and not nxt.startswith("#"):
+                        covers.append(j + 1)
+                        break
+            sups.append(Suppression(i, tuple(covers), passes))
+        return sups
+
+    # -- indexes -----------------------------------------------------------
+    def functions(self) -> Iterator[FunctionInfo]:
+        """Every function (nested included), with qualname/class/parent."""
+        yield from self._walk_functions(self.tree, prefix="",
+                                        class_name=None, parent=None)
+
+    def _walk_functions(self, node, prefix, class_name, parent):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                yield FunctionInfo(qual, child, class_name, parent)
+                yield from self._walk_functions(
+                    child, prefix=qual + ".", class_name=class_name,
+                    parent=child)
+            elif isinstance(child, ast.ClassDef):
+                yield from self._walk_functions(
+                    child, prefix=f"{prefix}{child.name}.",
+                    class_name=child.name, parent=parent)
+            else:
+                yield from self._walk_functions(child, prefix, class_name,
+                                                parent)
+
+    def classes(self) -> Iterator[ast.ClassDef]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                yield node
+
+    def toplevel_functions(self) -> Dict[str, ast.AST]:
+        """Module-level ``def`` index (call-graph closure roots)."""
+        if self._toplevel is None:
+            self._toplevel = {
+                n.name: n for n in self.tree.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        return self._toplevel
+
+    def function_index(self) -> Dict[str, ast.AST]:
+        """EVERY function in the file by bare name (nested included;
+        later definitions win). Used to resolve locally-defined traced
+        roots like a ``chain`` closure handed to ``jax.jit``."""
+        if self._fn_index is None:
+            self._fn_index = {info.name: info.node
+                              for info in self.functions()}
+        return self._fn_index
+
+    def module_aliases(self, module: str) -> Set[str]:
+        """Names this file binds to ``module`` (``import numpy as np`` →
+        {"np"} for module="numpy")."""
+        if module not in self._mod_aliases:
+            names: Set[str] = set()
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name == module:
+                            names.add(alias.asname
+                                      or alias.name.split(".")[0])
+            self._mod_aliases[module] = names
+        return self._mod_aliases[module]
+
+    def imported_names(self, module_suffix: str) -> Set[str]:
+        """Names imported ``from <...module_suffix> import X`` (suffix
+        match tolerates relative-import spellings)."""
+        if module_suffix not in self._imported:
+            names: Set[str] = set()
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.ImportFrom) and node.module and \
+                        node.module.endswith(module_suffix):
+                    names.update(a.asname or a.name for a in node.names)
+            self._imported[module_suffix] = names
+        return self._imported[module_suffix]
+
+
+def local_aliases(fn: ast.AST, chain_suffix: str) -> Set[str]:
+    """Local names assigned (anywhere in ``fn``, one level) from an
+    attribute chain ending in ``chain_suffix`` — e.g. suffix ``".app"``
+    catches ``app = self.app`` and ``app = ad.app``."""
+    names: Set[str] = set()
+    for node in walk_shallow(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        src = dotted(node.value)
+        if src is None or not src.endswith(chain_suffix):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    return names
+
+
+def assignment_targets(stmt: ast.stmt) -> List[ast.expr]:
+    """Flattened store targets of an assignment statement (tuple/list
+    targets unpacked); [] for non-assignments."""
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        raw = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        raw = [stmt.target]
+    else:
+        return targets
+    while raw:
+        t = raw.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            raw.extend(t.elts)
+        else:
+            targets.append(t)
+    return targets

@@ -16,6 +16,13 @@ Two step graphs per model, mirroring the reference submodel tags
 (model_wrapper.py:37-42): ``context_encoding`` (prefill) and
 ``token_generation`` (decode). Speculation graphs live in
 models/speculation.py; both reuse the layer stack here.
+
+Everything the jitted entry points here reach is a TRACED REGION: the
+``recompile-hazard`` pass of ``scripts/nxdi_lint.py`` derives it from
+the ``jax.jit``/``partial`` sites and flags host concretization
+(``.item()``/``float()``/host numpy on traced values), unordered
+set/dict iteration and mutated-closure captures — each one a silent
+bucket-ladder jit-cache miss (or a tracing crash) in production.
 """
 
 from __future__ import annotations

@@ -3,7 +3,8 @@ fault injection, and recompute-preemption policies.
 
 The serving adapters (``serving.py``) and the paged cache manager
 (``modules/block_kv_cache.py``) raise ONLY exceptions from this taxonomy at
-their public boundaries (enforced by ``scripts/check_error_paths.py``, a
+their public boundaries (enforced by the ``error-paths`` pass of
+``scripts/nxdi_lint.py``, a
 tier-1 lint). Every recovery path — transactional admission rollback,
 preemption under KV pressure, deadline budgets — is exercised on CPU by
 arming the fault points in :mod:`.faults`; no TPU, no flakiness.

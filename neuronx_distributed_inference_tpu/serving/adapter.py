@@ -45,7 +45,7 @@ Decode pipeline (see README "Decode pipeline"):
     refreshed only for rows whose block list actually grew.
   * The dispatch helpers (``_dispatch_*``) must never materialize device
     values — enforced by the tier-1 AST lint
-    ``scripts/check_host_sync.py``.
+    ``host-sync`` pass of ``scripts/nxdi_lint.py``.
 
 Chunked, packed, schedulable prefill — paged adapter only (see README
 "Chunked prefill"; reference analog: ragged/mixed-batch TPU prefill,
@@ -82,7 +82,7 @@ Resilience contract (see README "Serving resilience"):
 
   * every boundary failure is typed (``resilience.errors``) — never a bare
     ``ValueError``/``RuntimeError`` (enforced by
-    ``scripts/check_error_paths.py``);
+    the ``error-paths`` pass of ``scripts/nxdi_lint.py``);
   * ``add_requests`` is **transactional**: it either admits every sequence
     or rolls back all allocations/adapter state from the call and leaves
     device + cache state exactly as before;
@@ -1104,7 +1104,7 @@ class ContinuousBatchingAdapter(_EngineAdapterBase):
 
     def _dispatch_decode(self, scr: _CbScratch, toks_dev=None):
         """Issue ONE decode step to the device without materializing any
-        output (region lint: scripts/check_host_sync.py) — the blocking
+        output (region lint: nxdi_lint host-sync pass) — the blocking
         fetch happens in the caller (eager) or at retire time (pipelined).
         ``toks_dev``: previous dispatch's on-device tokens (pipelined
         feedback); None = host tokens from the scratch buffer."""
@@ -1473,7 +1473,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def _dispatch_decode(self, scr: _PagedScratch, toks_dev=None):
         """Issue ONE paged decode step to the device without materializing
-        any output (region lint: scripts/check_host_sync.py). ``toks_dev``:
+        any output (region lint: nxdi_lint host-sync pass). ``toks_dev``:
         previous dispatch's on-device tokens (pipelined feedback); None =
         host tokens from the scratch buffer."""
         ids = scr.ids if toks_dev is None else toks_dev
@@ -1897,7 +1897,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def _dispatch_prefill_chunk(self, packed, fetch: bool = True):
         """Issue ONE packed prefill-chunk dispatch without materializing
-        any output (region lint: scripts/check_host_sync.py) — the final-
+        any output (region lint: nxdi_lint host-sync pass) — the final-
         chunk token fetch happens in the caller, one async hop behind.
         ``fetch=False`` (intermediate-only dispatch) skips even the async
         device-to-host copy: those samples are never read."""

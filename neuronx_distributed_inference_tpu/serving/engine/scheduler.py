@@ -409,7 +409,7 @@ class ServingEngine:
         """Drive one decode horizon and route tokens to streams. This is
         the engine's dispatch-driving loop: it must stay free of host
         materialization of device values (tier-1 lint region,
-        ``scripts/check_host_sync.py``) — every token it touches is
+        ``scripts/nxdi_lint.py`` host-sync pass) — every token it touches is
         already a host int handed back by the adapter."""
         pending = set(self.adapter.pending_prefill_ids)
         alive = self.adapter.seqs

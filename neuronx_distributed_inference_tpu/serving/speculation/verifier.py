@@ -27,7 +27,8 @@ typed :class:`~...resilience.errors.StepFailure` — no half-accepted cache
 poisoning (pinned by tests/test_spec_serving.py). The dispatch helpers
 (``_dispatch_spec_draft`` / ``_dispatch_spec_verify``) must never
 materialize device values — tier-1 lint region
-(``scripts/check_host_sync.py``); the single blocking sync per step is
+(the ``host-sync`` pass of ``scripts/nxdi_lint.py``); the single
+blocking sync per step is
 the verify fetch.
 """
 
@@ -309,7 +310,7 @@ class SpeculativeDecodePath:
             self.proposer.forget(live)
         return res
 
-    # -- dispatch regions (scripts/check_host_sync.py) ---------------------
+    # -- dispatch regions (nxdi_lint host-sync pass) -----------------------
     def _dispatch_spec_draft(self, ctx: _SpecContext):
         """Issue the self-draft loop WITHOUT materializing any output —
         the draft tokens stay on device and feed the verify dispatch

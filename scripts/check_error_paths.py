@@ -1,76 +1,48 @@
 #!/usr/bin/env python
-"""Tier-1 lint: the serving surface raises ONLY the typed taxonomy.
+"""Back-compat shim over ``nxdi_lint``'s ``error-paths`` pass.
 
-Fails (rc 1) when any checked file contains ``raise ValueError(...)`` or
-``raise RuntimeError(...)`` — those must be one of the
-``resilience.errors`` types instead (``AdmissionError``,
-``CapacityError``, ``DeadlineExceeded``, ``StepFailure``, ...), so an
-engine can branch on exception type to pick a recovery path. Bare
-re-raises (``raise`` with no expression) and every other exception class
-are allowed.
+DEPRECATED entry point: the checker now lives in
+``neuronx_distributed_inference_tpu/analysis/passes/error_paths.py`` and
+runs with every other pass through ``scripts/nxdi_lint.py`` (suppression
+syntax, ``--json`` artifact, one process for the whole suite). This CLI
+is kept so existing invocations and muscle memory keep working; it
+accepts the same arguments and prints the same messages.
 
 Usage::
 
     python scripts/check_error_paths.py            # lint the default set
     python scripts/check_error_paths.py FILE...    # lint specific files
-
-Wired into the test suite as a tier-1 test
-(``tests/test_resilience.py::test_error_path_lint``).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import List, Sequence, Tuple
-
-BANNED = ("ValueError", "RuntimeError")
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-DEFAULT_PATHS = (
-    "neuronx_distributed_inference_tpu/serving/adapter.py",
-    "neuronx_distributed_inference_tpu/serving/engine/queue.py",
-    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
-    "neuronx_distributed_inference_tpu/serving/engine/streams.py",
-    "neuronx_distributed_inference_tpu/serving/engine/frontend.py",
-    "neuronx_distributed_inference_tpu/serving/speculation/__init__.py",
-    "neuronx_distributed_inference_tpu/serving/speculation/proposer.py",
-    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
-    "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
-)
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
+
+from nxdi_lint import load_analysis  # noqa: E402
 
 
-def banned_raises(source: str) -> List[Tuple[int, str]]:
-    """(lineno, exception name) for every ``raise`` of a banned builtin."""
-    bad: List[Tuple[int, str]] = []
-    for node in ast.walk(ast.parse(source)):
-        if not isinstance(node, ast.Raise) or node.exc is None:
-            continue
-        target = node.exc
-        if isinstance(target, ast.Call):
-            target = target.func
-        if isinstance(target, ast.Name) and target.id in BANNED:
-            bad.append((node.lineno, target.id))
-    return bad
-
-
-def main(argv: Sequence[str] = ()) -> int:
-    paths = [Path(p) for p in argv] if argv else \
-        [REPO_ROOT / p for p in DEFAULT_PATHS]
+def main(argv=()) -> int:
+    analysis = load_analysis()
+    ctx = analysis.LintContext(REPO_ROOT)
+    p = analysis.get_pass("error-paths")
+    # argv paths resolve against CWD like the old standalone CLI (the
+    # library API's relative paths resolve against the repo root)
+    paths = [str(Path(a).resolve()) for a in argv] or None
+    findings = analysis.run_single(ctx, p.name, paths=paths)
+    n_files = len(paths) if paths else len(p.default_paths)
     rc = 0
-    for path in paths:
-        if not path.exists():
-            print(f"check_error_paths: {path}: missing", file=sys.stderr)
-            rc = 1
-            continue
-        for lineno, name in banned_raises(path.read_text()):
-            print(f"{path}:{lineno}: raise {name}(...) — use the typed "
-                  "taxonomy in neuronx_distributed_inference_tpu/"
-                  "resilience/errors.py", file=sys.stderr)
-            rc = 1
+    for f in findings:
+        rc = 1
+        if f.line == 0:
+            print(f"check_error_paths: {f.path}: missing", file=sys.stderr)
+        else:
+            print(f"{f.path}:{f.line}: {f.message}", file=sys.stderr)
     if rc == 0:
-        print(f"check_error_paths: OK ({len(paths)} file(s) clean)")
+        print(f"check_error_paths: OK ({n_files} file(s) clean)")
     return rc
 
 

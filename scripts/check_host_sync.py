@@ -1,137 +1,67 @@
 #!/usr/bin/env python
-"""Tier-1 lint: no host-blocking materialization in the dispatch region.
+"""Back-compat shim over ``nxdi_lint``'s ``host-sync`` pass.
 
-The serving adapters' pipelined decode path relies on ``_dispatch_*``
-helpers issuing device work WITHOUT fetching any output — a blocking
-``np.asarray(out["tokens"])`` (or friends) inside the dispatch region
-would serialize host and device and silently destroy the pipeline's
-overlap. This lint fails (rc 1) when any function whose name starts with
-``_dispatch`` in the checked files contains a call spelled with one of
-the blocking/materializing attributes:
-
-    asarray  array  device_get  block_until_ready  item  tolist
-
-The list deliberately OVER-approximates: ``np.array`` over a host list
-would not block, but dispatch helpers take fully-prepared scratch inputs
-by contract, so any array construction inside the region is a smell and
-gets flagged too. The blocking fetch belongs in the retire/fetch helpers
-(``_retire`` / ``_fetch_rows``), which run one step behind the dispatch.
-
-The chunked-prefill path is covered the same way: the packed
-chunk-dispatch region (``_dispatch_prefill_chunk``) must only issue the
-device call and start the async copy — final-chunk tokens are fetched by
-the caller, one async hop behind. When the default file set is linted,
-the EXPECTED_REGIONS guard additionally fails the lint if a required
-region function disappears (a rename would otherwise silently drop its
-coverage).
+DEPRECATED entry point: the checker now lives in
+``neuronx_distributed_inference_tpu/analysis/passes/host_sync.py`` and
+runs with every other pass through ``scripts/nxdi_lint.py``. The old
+hand-maintained EXPECTED_REGIONS table (manually updated in PRs 5, 6
+and 9) is GONE: the shared walker now DERIVES dispatch-region coverage —
+a function that issues dispatch work without materializing must carry
+the ``_dispatch`` prefix, so a rename moves lint coverage instead of
+silently dropping it.
 
 Usage::
 
     python scripts/check_host_sync.py                 # lint the default set
     python scripts/check_host_sync.py FILE...         # lint specific files
     python scripts/check_host_sync.py --list-regions  # show linted regions
-
-Wired into the test suite as tier-1 tests
-(``tests/test_decode_pipeline.py::test_host_sync_lint`` and
-``tests/test_chunked_prefill.py::test_chunk_dispatch_region_linted``).
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import List, Sequence, Tuple
-
-BANNED_ATTRS = ("asarray", "array", "device_get", "block_until_ready",
-                "item", "tolist")
-REGION_PREFIX = "_dispatch"
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-DEFAULT_PATHS = (
-    "neuronx_distributed_inference_tpu/serving/adapter.py",
-    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
-    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
-)
-# region functions that MUST exist when linting the default set — a rename
-# must move coverage, not lose it
-EXPECTED_REGIONS = {
-    "neuronx_distributed_inference_tpu/serving/adapter.py": (
-        "_dispatch_decode",           # decode pipeline (both adapters)
-        "_dispatch_prefill_chunk",    # packed chunked prefill (paged)
-    ),
-    "neuronx_distributed_inference_tpu/serving/engine/scheduler.py": (
-        "_dispatch_engine_pass",      # serving engine dispatch-driving loop
-    ),
-    "neuronx_distributed_inference_tpu/serving/speculation/verifier.py": (
-        "_dispatch_spec_draft",       # speculative draft pass (self-draft)
-        "_dispatch_propose",          # proposer-side draft (Medusa/EAGLE)
-        "_dispatch_spec_verify",      # THE one verify dispatch per step
-    ),
-}
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
+
+from nxdi_lint import load_analysis  # noqa: E402
 
 
-def region_functions(source: str) -> List[str]:
-    """Names of every dispatch-region function in ``source``."""
-    return [node.name for node in ast.walk(ast.parse(source))
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and node.name.startswith(REGION_PREFIX)]
-
-
-def blocking_calls(source: str) -> List[Tuple[int, str, str]]:
-    """(lineno, function, attr) for every banned call inside a dispatch
-    region function."""
-    bad: List[Tuple[int, str, str]] = []
-    for node in ast.walk(ast.parse(source)):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if not node.name.startswith(REGION_PREFIX):
-            continue
-        for sub in ast.walk(node):
-            if not isinstance(sub, ast.Call):
-                continue
-            fn = sub.func
-            if isinstance(fn, ast.Attribute) and fn.attr in BANNED_ATTRS:
-                bad.append((sub.lineno, node.name, fn.attr))
-    return bad
-
-
-def main(argv: Sequence[str] = ()) -> int:
-    argv = list(argv)
+def main(argv=()) -> int:
+    analysis = load_analysis()
+    argv = [str(a) for a in argv]
     list_regions = "--list-regions" in argv
     argv = [a for a in argv if a != "--list-regions"]
-    default_set = not argv
-    paths = [Path(p) for p in argv] if argv else \
-        [REPO_ROOT / p for p in DEFAULT_PATHS]
+    ctx = analysis.LintContext(REPO_ROOT)
+    p = analysis.get_pass("host-sync")
+    # argv paths resolve against CWD like the old standalone CLI (the
+    # library API's relative paths resolve against the repo root)
+    paths = [str(Path(a).resolve()) for a in argv] or None
     rc = 0
-    for path in paths:
-        if not path.exists():
-            print(f"check_host_sync: {path}: missing", file=sys.stderr)
-            rc = 1
-            continue
-        source = path.read_text()
-        if list_regions:
-            for name in region_functions(source):
-                print(f"{path}: {name}")
-        for lineno, func, attr in blocking_calls(source):
-            print(f"{path}:{lineno}: .{attr}(...) inside dispatch-region "
-                  f"function {func!r} — device output must not be "
-                  "materialized before retire/fetch (decode pipeline "
-                  "contract)", file=sys.stderr)
-            rc = 1
-        if default_set:
-            rel = path.relative_to(REPO_ROOT).as_posix()
-            found = set(region_functions(source))
-            for required in EXPECTED_REGIONS.get(rel, ()):
-                if required not in found:
-                    print(f"check_host_sync: {path}: expected dispatch "
-                          f"region {required!r} is gone — renamed regions "
-                          "must keep the _dispatch prefix (and this list "
-                          "updated) or the lint loses coverage",
-                          file=sys.stderr)
-                    rc = 1
-    if rc == 0 and not list_regions:
-        print(f"check_host_sync: OK ({len(paths)} file(s) clean)")
+    if list_regions:
+        # list AND still lint, like the old CLI: --list-regions in a CI
+        # step must not report success on a tree with a violation
+        import importlib
+        hs_mod = importlib.import_module(type(p).__module__)
+        for rel in (paths or p.default_paths):
+            sf = ctx.source_for(Path(rel))
+            if sf is None:
+                print(f"check_host_sync: {rel}: missing", file=sys.stderr)
+                rc = 1
+                continue
+            for name in hs_mod.region_functions(sf):
+                print(f"{REPO_ROOT / sf.rel}: {name}")
+    findings = analysis.run_single(ctx, p.name, paths=paths)
+    for f in findings:
+        rc = 1
+        if f.line == 0:
+            print(f"check_host_sync: {f.path}: missing", file=sys.stderr)
+        else:
+            print(f"{f.path}:{f.line}: {f.message}", file=sys.stderr)
+    if rc == 0:
+        n_files = len(paths) if paths else len(p.default_paths)
+        print(f"check_host_sync: OK ({n_files} file(s) clean)")
     return rc
 
 

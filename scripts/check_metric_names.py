@@ -1,108 +1,51 @@
 #!/usr/bin/env python
-"""Tier-1 lint: the metric-name contract and the README table cannot drift.
+"""Back-compat shim over ``nxdi_lint``'s ``metric-names`` pass.
 
-Metric names are a stable contract (dashboards key on them) and the README
-"Observability" table is their documentation of record — but nothing
-enforced the pairing, and PR 6's ``nxdi_queue_*`` rows were synced by hand.
-This lint fails (rc 1) whenever the two diverge, in either direction:
-
-  * every ``nxdi_*`` name constant in ``telemetry/metrics.py`` (the single
-    registration point for canonical names) must appear in the README
-    Observability table;
-  * every ``nxdi_*`` name in that table must be a registered constant —
-    a documented-but-unregistered metric is a typo or a leftover.
+DEPRECATED entry point: the checker now lives in
+``neuronx_distributed_inference_tpu/analysis/passes/metric_names.py``
+and runs with every other pass through ``scripts/nxdi_lint.py``. Kept
+for existing invocations; same arguments, same messages.
 
 Usage::
 
     python scripts/check_metric_names.py                  # lint the repo
     python scripts/check_metric_names.py --metrics F --readme F   # custom
-
-Wired into the test suite as a tier-1 test
-(``tests/test_flight_recorder.py::test_metric_names_lint``).
 """
 
 from __future__ import annotations
 
-import ast
-import re
 import sys
 from pathlib import Path
-from typing import Sequence, Set
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
-METRICS_PATH = (REPO_ROOT / "neuronx_distributed_inference_tpu" /
-                "telemetry" / "metrics.py")
-README_PATH = REPO_ROOT / "README.md"
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
 
-_NAME_RE = re.compile(r"nxdi_[a-z0-9_]+")
+from nxdi_lint import load_analysis  # noqa: E402
 
 
-def registered_names(metrics_source: str) -> Set[str]:
-    """``nxdi_*`` string constants assigned at module level in
-    telemetry/metrics.py — the canonical registration point."""
-    names: Set[str] = set()
-    for node in ast.parse(metrics_source).body:
-        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-            continue
-        value = node.value
-        if (isinstance(value, ast.Constant) and isinstance(value.value, str)
-                and value.value.startswith("nxdi_")):
-            names.add(value.value)
-    return names
-
-
-def documented_names(readme_source: str) -> Set[str]:
-    """``nxdi_*`` names in the README Observability metric table (table
-    rows only — prose mentions elsewhere are cross-references, not
-    documentation of record)."""
-    lines = readme_source.splitlines()
-    try:
-        start = next(i for i, l in enumerate(lines)
-                     if l.strip() == "## Observability")
-    except StopIteration:
-        return set()
-    names: Set[str] = set()
-    for line in lines[start + 1:]:
-        if line.startswith("## "):
-            break
-        if line.lstrip().startswith("|"):
-            names.update(_NAME_RE.findall(line))
-    return names
-
-
-def main(argv: Sequence[str] = ()) -> int:
-    argv = list(argv)
-    metrics_path, readme_path = METRICS_PATH, README_PATH
+def main(argv=()) -> int:
+    analysis = load_analysis()
+    argv = [str(a) for a in argv]
+    p = analysis.get_pass("metric-names")
+    # defaults stay repo-relative (resolved against the repo root);
+    # flag values resolve against CWD like the old standalone CLI
+    metrics_path, readme_path = p.default_paths
     if "--metrics" in argv:
-        metrics_path = Path(argv[argv.index("--metrics") + 1])
+        metrics_path = str(Path(argv[argv.index("--metrics") + 1]).resolve())
     if "--readme" in argv:
-        readme_path = Path(argv[argv.index("--readme") + 1])
-    rc = 0
-    registered = registered_names(metrics_path.read_text())
-    documented = documented_names(readme_path.read_text())
-    if not registered:
-        print(f"check_metric_names: no nxdi_* constants found in "
-              f"{metrics_path} — wrong file?", file=sys.stderr)
+        readme_path = str(Path(argv[argv.index("--readme") + 1]).resolve())
+    ctx = analysis.LintContext(REPO_ROOT)
+    findings = p.run(ctx, paths=(metrics_path, readme_path))
+    for f in findings:
+        print(f"check_metric_names: {f.message}", file=sys.stderr)
+    if findings:
         return 1
-    if not documented:
-        print(f"check_metric_names: no Observability metric table found in "
-              f"{readme_path} — wrong file?", file=sys.stderr)
-        return 1
-    for name in sorted(registered - documented):
-        print(f"check_metric_names: {name} is registered in "
-              f"{metrics_path.name} but missing from the README "
-              "Observability table — document it (names are a stable "
-              "contract)", file=sys.stderr)
-        rc = 1
-    for name in sorted(documented - registered):
-        print(f"check_metric_names: {name} appears in the README "
-              f"Observability table but is not registered in "
-              f"{metrics_path.name} — typo or leftover row",
-              file=sys.stderr)
-        rc = 1
-    if rc == 0:
-        print(f"check_metric_names: OK ({len(registered)} names in sync)")
-    return rc
+    import importlib
+    mn_mod = importlib.import_module(type(p).__module__)
+    sf = ctx.source_for(Path(metrics_path))
+    print(f"check_metric_names: OK ({len(mn_mod.registered_names(sf.tree))} "
+          "names in sync)")
+    return 0
 
 
 if __name__ == "__main__":

@@ -52,6 +52,12 @@ Usage::
 Wired into the suite as a tier-1 test
 (``tests/test_sharding_observatory.py``), including a doctored-HLO
 negative test proving the remat detector fires.
+
+Relationship to ``scripts/nxdi_lint.py``: this script stays the COMPILE
+lint (a CPU-mesh XLA compile set is minutes of work, not an AST pass),
+while its static golden/pin consistency slice — golden schema, PINNED
+<-> golden graph-set sync, census well-formedness — runs in-process with
+every other pass as ``nxdi_lint``'s ``spmd-golden`` pass.
 """
 
 from __future__ import annotations

@@ -92,3 +92,20 @@ def tiny_llama_hf_config(**over):
 @pytest.fixture
 def tiny_config_dict():
     return tiny_llama_hf_config()
+
+
+def load_nxdi_lint():
+    """Import scripts/nxdi_lint.py (and through it the stdlib-only
+    analysis package) once, shared by every lint-asserting test module —
+    no subprocess, no second copy of the registry."""
+    import importlib.util
+    import sys as _sys
+    if "nxdi_lint" in _sys.modules:
+        return _sys.modules["nxdi_lint"]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "nxdi_lint", os.path.join(repo, "scripts", "nxdi_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    _sys.modules["nxdi_lint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
