@@ -437,6 +437,143 @@ def serving_load_main(artifact_path="artifacts/bench_serving_r08.json"):
         print(f"serving-load artifact write failed: {e}", file=sys.stderr)
 
 
+def fleet_load_main(artifact_path="artifacts/bench_fleet_r11.json"):
+    """CPU-runnable closed-loop fleet microbench (ISSUE 11): two
+    ServingEngine replicas (same synthetic weights) behind the
+    EngineRouter, sharing one host-RAM KV spill tier, under a two-wave
+    prefix-grouped workload on an undersized block pool — so
+    prefix-affinity routing, LRU spill and tier restore all actually
+    fire. Reports N-replica routing fairness (min/max requests routed
+    per replica), the affinity hit-rate (share of routing decisions that
+    found a warm replica), spill/restore/evict counts from the shared
+    tier, and client-observed TTFT/TPOT p50/p99 (reference yardstick for
+    WHAT a fleet reports: the Gemma-on-Cloud-TPU serving comparison,
+    PAPERS.md arxiv 2605.25645). One parseable JSON line + an artifact
+    file; no TPU required."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
+    from neuronx_distributed_inference_tpu.serving.fleet import (
+        EngineRouter, HostKVSpillTier)
+
+    hf = _tiny_llama_hf()
+    batch, max_new, n_groups = 4, 8, 6
+    prefix_len, suffix_len = 32, 4               # 2 full 16-token blocks
+
+    def make_engine():
+        # pa_num_blocks undersized (12 usable ~= the full-batch working set)
+        # so steady-state admissions actually evict LRU residents — the
+        # spill tier's reason to exist
+        tcfg = TpuConfig(batch_size=batch, seq_len=128, dtype="float32",
+                         enable_bucketing=True,
+                         context_encoding_buckets=[16, 64],
+                         is_block_kv_layout=True, pa_block_size=16,
+                         pa_num_blocks=12, is_prefix_caching=True)
+        app = PagedCausalLMApplication(None,
+                                       LlamaInferenceConfig(tcfg, **hf),
+                                       LlamaFamily)
+        app.init_random_weights(seed=0).init_cache()
+        adapter = PagedEngineAdapter(app, kv_spill_tier=tier)
+        return ServingEngine(adapter, starvation_bound_s=30.0)
+
+    # ONE shared tier: content-hash keying makes cross-replica sharing
+    # safe (same weights => same payload per chain hash), so warmth
+    # spilled by one replica is restorable by the other
+    tier = HostKVSpillTier(max_blocks=64)
+    router = EngineRouter({"r0": make_engine(), "r1": make_engine()})
+
+    rng = np.random.default_rng(0)
+    prefixes = [rng.integers(1, 500, size=prefix_len).tolist()
+                for _ in range(n_groups)]
+    records = []
+
+    def submit(prompt):
+        s = router.submit(prompt, max_new)
+        records.append({
+            "stream": s,
+            "replica": router._requests[s.request_id].replica,
+            "t_submit": time.perf_counter(), "t_first": None,
+            "t_done": None})
+
+    def drain():
+        while router.has_work:
+            router.run_pass()
+            now = time.perf_counter()
+            for r in records:
+                if r["t_first"] is None and r["stream"].n_tokens:
+                    r["t_first"] = now
+                if r["t_done"] is None and r["stream"].finished:
+                    r["t_done"] = now
+
+    t_start = time.perf_counter()
+    for wave in range(2):
+        # two requests per prefix group per wave, with MORE distinct
+        # prefix groups than the undersized pool can keep resident: the
+        # oversubscribed wave churns the prefix cache (LRU evictions →
+        # spills), and wave 2 re-presents every prefix so affinity
+        # routing and tier restores are exercised, not measured at zero
+        for g, prefix in enumerate(prefixes):
+            for j in range(2):
+                submit(prefix + rng.integers(1, 500,
+                                             size=suffix_len).tolist())
+        drain()
+    wall = time.perf_counter() - t_start
+
+    assert all(r["stream"].finish_reason == "length" for r in records)
+    per_replica = {}
+    for r in records:
+        per_replica[r["replica"]] = per_replica.get(r["replica"], 0) + 1
+    fairness = (min(per_replica.values()) / max(per_replica.values())
+                if len(per_replica) > 1 else 0.0)
+    routed = router.stats["routed"]
+    hit_rate = router.stats["affinity_warm"] / max(routed, 1)
+    ttft = np.asarray([r["t_first"] - r["t_submit"] for r in records])
+    tpot = np.asarray([(r["t_done"] - r["t_first"]) / (max_new - 1)
+                       for r in records])
+    pct = lambda a, q: float(np.percentile(a, q) * 1e3)  # noqa: E731
+    payload = {
+        "metric": "fleet_load_affinity_hit_rate",
+        "value": round(hit_rate, 4),
+        "unit": "warm_routes_over_routes_2_replicas",
+        "details": {
+            "requests": len(records),
+            "replicas": 2,
+            "routed_per_replica": per_replica,
+            "routing_fairness_min_over_max": round(fairness, 4),
+            "affinity": {"warm": router.stats["affinity_warm"],
+                         "cold": router.stats["affinity_cold"]},
+            "kv_tier": {k: tier.stats[k] for k in
+                        ("spilled", "restored", "evicted", "hits",
+                         "misses", "spill_errors")},
+            "kv_tier_resident_blocks": len(tier),
+            "ttft_ms": {"p50": round(pct(ttft, 50), 2),
+                        "p99": round(pct(ttft, 99), 2)},
+            "tpot_ms": {"p50": round(pct(tpot, 50), 2),
+                        "p99": round(pct(tpot, 99), 2)},
+            "preempt_requeues": sum(
+                rep.engine.stats["preempt_requeues"]
+                for rep in router.replicas.values()),
+            "wall_s": round(wall, 2),
+            "batch_per_replica": batch,
+            "pa_num_blocks": 12,
+            "prefix_groups": n_groups,
+            "max_new_tokens": max_new,
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "fleet-load")
+
+
 def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
     """CPU-runnable compiled-graph observatory report (ISSUE 7): AOT
     ``.lower().compile()`` of every bucket-ladder graph of the tiny
@@ -612,6 +749,7 @@ def _no_tpu_fallback(error: str):
                      ("prefill_overhead", prefill_overhead_main),
                      ("spec_overhead", spec_overhead_main),
                      ("serving_load", serving_load_main),
+                     ("fleet_load", fleet_load_main),
                      ("graph_report", graph_report_main),
                      ("lint_report", lint_report_main)):
         try:
@@ -660,6 +798,8 @@ def main():
         return spec_overhead_main()
     if "--serving-load" in sys.argv[1:]:
         return serving_load_main()
+    if "--fleet-load" in sys.argv[1:]:
+        return fleet_load_main()
     if "--graph-report" in sys.argv[1:]:
         return graph_report_main()
     if "--sharding-report" in sys.argv[1:]:

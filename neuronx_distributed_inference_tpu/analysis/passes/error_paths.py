@@ -26,6 +26,10 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/speculation/__init__.py",
     "neuronx_distributed_inference_tpu/serving/speculation/proposer.py",
     "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/__init__.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/router.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/kv_tier.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/handoff.py",
     "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
 )
 

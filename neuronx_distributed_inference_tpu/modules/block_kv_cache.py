@@ -37,7 +37,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.mesh import AXIS_MP
-from ..resilience.errors import CapacityError, KVCacheStateError
+from ..resilience.errors import (CapacityError, ConfigurationError,
+                                 KVCacheStateError)
 from ..resilience.faults import FAULTS as _FAULTS
 from ..telemetry import get_registry, metrics as tmetrics
 
@@ -205,6 +206,11 @@ class BlockAllocator:
         self.free_list: List[int] = list(range(1, num_blocks))  # 0 = null block
         self.hash_to_block: Dict[bytes, int] = {}
         self._lru: List[int] = []          # cached, ref_count==0, oldest first
+        # eviction hook (host-RAM KV spill tier, serving/fleet/): called
+        # with (block_id, chain_hash) just BEFORE an LRU-resident prefix
+        # block's hash registration is dropped — the last moment its
+        # device payload is still identifiable by content
+        self.on_evict = None
 
     @property
     def num_free(self) -> int:
@@ -217,6 +223,8 @@ class BlockAllocator:
             blk = self._lru.pop(0)
             h = self.meta[blk].content_hash
             if h is not None:
+                if self.on_evict is not None:
+                    self.on_evict(blk, h)
                 self.hash_to_block.pop(h, None)
             self.meta[blk] = _BlockMeta()
             return blk
@@ -555,6 +563,39 @@ class BlockKVCacheManager:
             self.allocator.invalidate(
                 [b for b in blocks if b in unwritten])
         self._tel_occupancy()
+
+    def set_spill_hook(self, hook) -> None:
+        """Install ``hook(block_id, chain_hash)`` to run just before a
+        prefix-cached resident block is LRU-evicted (the moment its
+        content would otherwise become unreachable) — the attach point of
+        the host-RAM KV spill tier (serving/fleet/kv_tier.py).
+
+        The hook is keyed by the PYTHON allocator's blake2b chain hashes
+        (the same :func:`_hash_block` chain the spill tier and the
+        handoff records use), so it requires the Python
+        :class:`BlockAllocator`. A native (C++) allocator is swapped for
+        an equivalent fresh Python one when NOTHING live depends on it —
+        no sequence tables and every block free. The swap may still
+        discard unreferenced prefix-cache residency (warm prompts
+        recompute once); it can never discard live sequence state —
+        swapping with live tables (or referenced blocks) raises typed
+        instead. The hook must not raise — the adapter's spill hook
+        swallows and counts its own failures (``kv_spill`` fault-point
+        contract)."""
+        alloc = self.allocator
+        if not isinstance(alloc, BlockAllocator):
+            if self.tables or alloc.num_free != self.spec.num_blocks - 1:
+                raise ConfigurationError(
+                    "set_spill_hook needs the Python BlockAllocator's "
+                    "eviction callback, and this manager's native "
+                    "allocator holds live state — attach the spill "
+                    "tier before the first admission (or build with "
+                    "NXDI_TPU_NATIVE=0)")
+            alloc = BlockAllocator(self.spec.num_blocks,
+                                   self.spec.block_size,
+                                   alloc.enable_prefix_caching)
+            self.allocator = alloc
+        alloc.on_evict = hook
 
     def probe_cached_tokens(self, token_ids: Sequence[int]
                             ) -> Tuple[int, List[int]]:

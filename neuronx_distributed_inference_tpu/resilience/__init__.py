@@ -11,9 +11,9 @@ arming the fault points in :mod:`.faults`; no TPU, no flakiness.
 """
 
 from .errors import (AdmissionError, Cancelled, CapacityError,
-                     ConfigurationError, DeadlineExceeded, KVCacheStateError,
-                     QueueOverflow, SequenceStateError, ServingError,
-                     StepFailure)
+                     ConfigurationError, DeadlineExceeded, HandoffError,
+                     KVCacheStateError, QueueOverflow, ReplicaUnavailable,
+                     SequenceStateError, ServingError, StepFailure)
 from .faults import FAULT_POINTS, FAULTS, FaultInjector, InjectedFault
 from .preemption import PREEMPTION_POLICIES, Preempted, pick_victim
 
@@ -21,6 +21,7 @@ __all__ = [
     "ServingError", "AdmissionError", "CapacityError", "ConfigurationError",
     "DeadlineExceeded", "KVCacheStateError", "SequenceStateError",
     "StepFailure", "QueueOverflow", "Cancelled",
+    "ReplicaUnavailable", "HandoffError",
     "FAULTS", "FAULT_POINTS", "FaultInjector", "InjectedFault",
     "Preempted", "PREEMPTION_POLICIES", "pick_victim",
 ]

@@ -21,6 +21,7 @@ __all__ = [
     "ServingError", "AdmissionError", "SequenceStateError",
     "ConfigurationError", "CapacityError", "KVCacheStateError",
     "DeadlineExceeded", "StepFailure", "QueueOverflow", "Cancelled",
+    "ReplicaUnavailable", "HandoffError",
 ]
 
 
@@ -82,6 +83,22 @@ class QueueOverflow(CapacityError):
     or device state. A load balancer should shed or retry elsewhere.
     Subclasses :class:`CapacityError` so capacity-aware callers handle
     both with one clause."""
+
+
+class ReplicaUnavailable(CapacityError):
+    """The fleet router has no replica able to take the request: every
+    replica is draining or dead (or the one a caller targeted is). A load
+    balancer should shed or retry elsewhere. Subclasses
+    :class:`CapacityError` — like :class:`QueueOverflow` it is a
+    load-shedding signal, not a caller bug."""
+
+
+class HandoffError(ServingError, RuntimeError):
+    """A disaggregated prefill→decode handoff failed: malformed or
+    wrong-schema record, capture of a sequence in the wrong lifecycle
+    state, or a decode-side admission that could not consume the record.
+    The failing side's engine state is unchanged (capture reads before it
+    releases; admission is transactional)."""
 
 
 class Cancelled(ServingError):

@@ -42,6 +42,20 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      its KV, so mid-verify failure must roll EVERY packed
                      row back to its last accepted token (no
                      half-accepted cache poisoning)
+  ``kv_spill``       a block payload spill into the host-RAM KV tier
+                     (serving/fleet/kv_tier.py) — spills are best-effort:
+                     a trip is swallowed by the adapter's spill hook and
+                     counted (``tier.stats["spill_errors"]``), never
+                     failing the allocation that evicted the block
+  ``kv_restore``     the device write that re-admits spilled block
+                     payloads inside ``add_requests`` — fires BEFORE the
+                     write, so the transactional admission rollback
+                     (nothing admitted, free pool restored exactly) is
+                     provable; retry heals
+  ``handoff``        a prefill→decode handoff (serving/fleet/handoff.py),
+                     fired on BOTH capture and admit — either side fails
+                     typed (:class:`~.errors.HandoffError`) with its
+                     engine state unchanged
 
 Hot-path cost while nothing is armed: a single attribute check
 (``FAULTS.active``) — no call, no allocation (pinned by
@@ -59,7 +73,8 @@ __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 
 FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
                 "decode_step", "slow_step", "pipeline_flush",
-                "spec_draft", "spec_verify")
+                "spec_draft", "spec_verify",
+                "kv_spill", "kv_restore", "handoff")
 
 
 class InjectedFault(RuntimeError):

@@ -74,6 +74,55 @@ class Preempted:
             "meta": [self.meta],
         }
 
+    def to_json(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """JSON-safe serialization, so a requeue/handoff record can cross
+        a process boundary (the fleet handoff contract rides this —
+        serving/fleet/handoff.py). The absolute ``deadline`` is a
+        ``perf_counter()`` value with no meaning in another process, so
+        it is serialized as the REMAINING relative budget (the victim's
+        clock keeps running while the record is in flight) and re-anchored
+        by :meth:`from_json`. ``meta`` must itself be JSON-safe — the
+        serving engine's meta (request_id/tenant/priority dict) is."""
+        if now is None:
+            now = time.perf_counter()
+        return {
+            "schema": "nxdi-preempted-v1",
+            "seq_id": int(self.seq_id),
+            "tokens": [int(t) for t in self.tokens],
+            "prompt_len": int(self.prompt_len),
+            "n_generated": int(self.n_generated),
+            "reason": self.reason,
+            "deadline_remaining_s": (None if self.deadline is None
+                                     else max(self.deadline - now, 0.0)),
+            "meta": self.meta,
+            "trace_id": self.trace_id,
+        }
+
+    @classmethod
+    def from_json(cls, data: Dict[str, Any],
+                  now: Optional[float] = None) -> "Preempted":
+        """Inverse of :meth:`to_json`: re-anchors the remaining deadline
+        budget to THIS process's ``perf_counter()`` clock. Raises
+        ``KeyError`` on a wrong-schema payload (callers that accept
+        records over the wire wrap it typed — see
+        serving/fleet/handoff.py)."""
+        if data.get("schema") != "nxdi-preempted-v1":
+            raise KeyError(f"not an nxdi-preempted-v1 record: "
+                           f"schema={data.get('schema')!r}")
+        if now is None:
+            now = time.perf_counter()
+        rem = data["deadline_remaining_s"]
+        return cls(
+            seq_id=int(data["seq_id"]),
+            tokens=tuple(int(t) for t in data["tokens"]),
+            prompt_len=int(data["prompt_len"]),
+            n_generated=int(data["n_generated"]),
+            reason=str(data["reason"]),
+            deadline=None if rem is None else now + float(rem),
+            meta=data.get("meta"),
+            trace_id=data.get("trace_id"),
+        )
+
 
 def pick_victim(policy: str,
                 candidates: Iterable[Tuple[int, int, int]]) -> Optional[int]:

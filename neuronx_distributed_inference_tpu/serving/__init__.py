@@ -6,7 +6,9 @@ door; see README "Serving engine").
 
 Importing ``neuronx_distributed_inference_tpu.serving`` keeps exposing the
 adapter surface unchanged (this module used to be ``serving.py``); the
-engine layer is imported explicitly from ``.engine``.
+engine layer is imported explicitly from ``.engine``, and the fleet layer
+above it (replicated-engine router, host-RAM KV spill tier, disaggregated
+prefill handoff — README "Fleet") explicitly from ``.fleet``.
 """
 
 from .adapter import (ContinuousBatchingAdapter, PagedEngineAdapter,

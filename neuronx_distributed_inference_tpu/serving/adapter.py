@@ -1213,7 +1213,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
                  pipeline_depth: int = 0,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefill_budget_tokens: Optional[int] = None,
-                 speculation=None):
+                 speculation=None, kv_spill_tier=None):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -1247,6 +1247,14 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self._chunks: Dict[int, _ChunkState] = {}   # pending admissions
         self._unwritten: set = set()   # allocated blocks not fully written
         self._init_decode_path(pipeline_depth)
+        # host-RAM KV spill tier (serving/fleet/kv_tier.py): evicted
+        # prefix blocks spill their payloads host-side and re-admit via
+        # async H2D restore instead of recompute-prefill (README "Fleet")
+        self._kv_tier = kv_spill_tier
+        self.host_stats["kv_spilled_blocks"] = 0
+        self.host_stats["kv_restored_blocks"] = 0
+        if kv_spill_tier is not None:
+            app.kv_mgr.set_spill_hook(self._spill_block)
         if speculation is not None:
             # deferred import: speculation/ imports this module
             from .speculation import SelfDraftProposer, SpeculativeDecodePath
@@ -1326,6 +1334,15 @@ class PagedEngineAdapter(_EngineAdapterBase):
                                             self._unwritten)
                 c = min(c, len(prompt) - 1)
                 self._unwritten.update(blocks[n_hit:])
+                if self._kv_tier is not None:
+                    # swap instead of recompute: consecutive spilled
+                    # full blocks past the device prefix hit restore by
+                    # one batched H2D write; restored blocks stay in
+                    # _unwritten until the call's first MATERIALIZED
+                    # dispatch confirms the write chain, exactly like
+                    # chunk-written blocks
+                    c = self._restore_spilled(sid, prompt, blocks,
+                                              int(c))
                 self._admit_counter += 1
                 self._chunks[sid] = _ChunkState(
                     prompt=prompt, done=int(c),
@@ -1584,14 +1601,114 @@ class PagedEngineAdapter(_EngineAdapterBase):
         chunked admissions) — exactly the cut a real admission would
         apply. Schedulers use it to order admission batches warm-first;
         capped at ``len(prompt) - 1`` like admission itself (the final
-        token always runs to produce the first sample)."""
+        token always runs to produce the first sample). With a host KV
+        spill tier attached, consecutive spilled full blocks past the
+        device hit count as warm too (an admission would restore, not
+        recompute, them) — the fleet router's affinity signal."""
         from ..modules.block_kv_cache import cut_cached_at_unwritten
         cached, blocks = self.app.kv_mgr.probe_cached_tokens(prompt)
         if cached and self._unwritten:
             cached = cut_cached_at_unwritten(
                 blocks, cached, self.app.kv_mgr.spec.block_size,
                 self._unwritten)
+        if self._kv_tier is not None:
+            cached = self._tier_warmth(prompt, cached)
         return min(cached, len(prompt) - 1)
+
+    # -- host-RAM KV spill tier (serving/fleet/kv_tier.py) -----------------
+    def _spill_block(self, blk: int, content_hash: bytes) -> None:
+        """Manager eviction hook: copy an LRU-evicted prefix block's
+        payload device→host into the spill tier, keyed by its content
+        chain hash. Best-effort by contract — a failure (including the
+        ``kv_spill`` fault point) is swallowed and counted, never failing
+        the allocation whose eviction triggered it. Skips blocks whose
+        registered hash never had its content land (``_unwritten``)."""
+        if blk in self._unwritten:
+            return
+        try:
+            cache = self.app.cache
+            self._kv_tier.spill(content_hash,
+                                np.asarray(cache["k"][:, blk]),
+                                np.asarray(cache["v"][:, blk]))
+            self.host_stats["kv_spilled_blocks"] += 1
+        except Exception:
+            self._kv_tier.stats["spill_errors"] += 1
+
+    def _tier_warmth(self, prompt: Sequence[int], cached: int) -> int:
+        """Extend the device prefix-hit count with consecutive spilled
+        full blocks an admission right now would restore instead of
+        recompute (read-only; no recency touch)."""
+        from ..modules.block_kv_cache import _hash_block
+        bs = self.app.kv_mgr.spec.block_size
+        parent = b""
+        warm = cached
+        for bi in range(len(prompt) // bs):
+            parent = _hash_block(parent, list(prompt[bi * bs:(bi + 1) * bs]))
+            if (bi + 1) * bs <= cached:
+                continue                   # device-cached already
+            if bi * bs != warm or not self._kv_tier.contains(parent):
+                break
+            warm = (bi + 1) * bs
+        return warm
+
+    def _restore_spilled(self, sid: int, prompt: Sequence[int],
+                         blocks: Sequence[int], done: int) -> int:
+        """Walk the prompt's full-block chain hashes past the (post-cut)
+        device prefix hit through the spill tier; consecutive hits are
+        re-admitted by ONE batched async H2D write and their tokens
+        skipped from recompute-prefill. Returns the new ``done`` count
+        (capped at ``len(prompt) - 1`` like prefix hits — the final token
+        always runs to produce the first sample; a restored block that
+        covers it is partially rewritten with identical values by the
+        final chunk). The ``kv_restore`` fault point fires BEFORE the
+        device write, so the transactional admission rollback is exact."""
+        from ..modules.block_kv_cache import _hash_block
+        tier = self._kv_tier
+        bs = self.app.kv_mgr.spec.block_size
+        limit = len(prompt) - 1
+        parent = b""
+        restores: List[Tuple[int, Any]] = []
+        new_done = done
+        for bi in range(len(prompt) // bs):
+            parent = _hash_block(parent,
+                                 list(prompt[bi * bs:(bi + 1) * bs]))
+            if (bi + 1) * bs <= new_done:
+                continue                   # device-cached already
+            if bi * bs != new_done or new_done >= limit:
+                break                      # mid-block cap or gap: stop
+            payload = tier.get(parent)
+            if payload is None:
+                break
+            restores.append((blocks[bi], payload))
+            new_done = min((bi + 1) * bs, limit)
+        if not restores:
+            return done
+        if _FAULTS.active:
+            _FAULTS.fire("kv_restore")
+        self._apply_block_payloads(restores)
+        n_tok = new_done - done
+        tier.note_restored(len(restores), n_tok)
+        self.host_stats["kv_restored_blocks"] += len(restores)
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("kv.restore", cat="fleet", engine=self.engine_name,
+                        seq_id=int(sid), blocks=len(restores),
+                        tokens=n_tok)
+        return new_done
+
+    def _apply_block_payloads(self, restores) -> None:
+        """One batched (asynchronously dispatched) H2D write placing
+        spilled payloads into their freshly-allocated device blocks. The
+        rebound cache feeds every subsequent dispatch, so the call's
+        first materialized fetch orders after (and thereby confirms) the
+        restore writes — a deferred device failure here surfaces at that
+        fetch and rolls the admission back like any chunk failure."""
+        idx = np.asarray([b for b, _ in restores], np.intp)
+        k = np.stack([np.asarray(p["k"]) for _, p in restores], axis=1)
+        v = np.stack([np.asarray(p["v"]) for _, p in restores], axis=1)
+        cache = self.app.cache
+        self.app.cache = {"k": cache["k"].at[:, idx].set(k),
+                          "v": cache["v"].at[:, idx].set(v)}
 
     # -- preemption -------------------------------------------------------
     def preempt(self, seq_id: int, reason: str = "scheduler") -> Preempted:

@@ -49,7 +49,8 @@ import asyncio
 import json
 from typing import Any, Dict, Optional, Tuple
 
-from ...resilience.errors import AdmissionError, QueueOverflow, ServingError
+from ...resilience.errors import (AdmissionError, ConfigurationError,
+                                  QueueOverflow, ServingError)
 from ...telemetry import get_registry
 from ...telemetry.trace import get_recorder
 from .scheduler import ServingEngine
@@ -74,13 +75,25 @@ _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
 
 class ServingFrontend:
     """Owns the listener socket, the engine's ``run_forever`` task, and
-    the per-connection request handlers."""
+    the per-connection request handlers.
+
+    ``max_retained_streams`` bounds the ``/v1/submit`` stream registry
+    (oldest FINISHED streams beyond it are dropped; default 256 — the
+    pre-knob hardcoded bound, pinned by tests). ``fleet`` optionally
+    attaches an :class:`~..fleet.router.EngineRouter` whose
+    ``debug_state()`` is served as the ``fleet`` section of
+    ``GET /v1/debug/state``."""
 
     def __init__(self, engine: ServingEngine, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, max_retained_streams: int = 256,
+                 fleet=None):
+        if max_retained_streams < 1:
+            raise ConfigurationError("max_retained_streams must be >= 1")
         self.engine = engine
         self.host = host
         self.port = port
+        self.max_retained_streams = max_retained_streams
+        self.fleet = fleet
         self._server: Optional[asyncio.base_events.Server] = None
         self._engine_task: Optional[asyncio.Task] = None
         self._streams: Dict[str, TokenStream] = {}   # submitted via HTTP
@@ -162,8 +175,7 @@ class ServingFrontend:
         elif path == "/v1/debug/state" and method == "GET":
             # live post-mortem: engine/adapter snapshot + flight-recorder
             # tail (events empty while the recorder is disabled)
-            await self._send_json(writer, 200,
-                                  self.engine.dump_debug_state())
+            await self._send_json(writer, 200, self._debug_payload())
         elif path == "/v1/debug/trace" and method == "GET":
             # Chrome trace-event JSON — save the body and load it in
             # chrome://tracing or Perfetto
@@ -201,14 +213,21 @@ class ServingFrontend:
                              f"no route for {method} {path}")
 
     # -- engine glue -------------------------------------------------------
-    _MAX_RETAINED_STREAMS = 256
+    def _debug_payload(self) -> Dict[str, Any]:
+        """The ``GET /v1/debug/state`` body: the engine post-mortem dump
+        plus — with a fleet router attached — the router's snapshot
+        (per-replica health/load, routing stats, in-flight bindings)."""
+        payload = self.engine.dump_debug_state()
+        if self.fleet is not None:
+            payload["fleet"] = self.fleet.debug_state()
+        return payload
 
     def _prune_streams(self) -> None:
         """Bound the /v1/submit registry: drop the oldest FINISHED streams
         beyond the cap (dict preserves insertion order), so a long-lived
         server does not retain one token list per request forever.
         Unfinished streams are never dropped — their requests are live."""
-        excess = len(self._streams) - self._MAX_RETAINED_STREAMS + 1
+        excess = len(self._streams) - self.max_retained_streams + 1
         if excess <= 0:
             return
         for rid in [r for r, s in self._streams.items()

@@ -180,6 +180,40 @@ class ServingEngine:
         self.stats["cancelled"] += 1
         return True
 
+    def submit_record(self, rec, max_new_tokens: int, *,
+                      stop_tokens: Sequence[int] = (),
+                      request_id: Optional[str] = None) -> TokenStream:
+        """Submit one :class:`~...resilience.Preempted` record — the
+        fleet router's replica-failover path, riding the same
+        ``admission_kwargs()`` requeue contract the in-engine preemption
+        requeue uses: the record's tokens are the recompute prompt, its
+        remaining deadline budget carries over, and tenant/priority come
+        from the meta passthrough. ``max_new_tokens`` is the REMAINING
+        token budget (the caller already delivered the rest)."""
+        kw = rec.admission_kwargs()
+        meta = kw["meta"][0] if isinstance(kw["meta"][0], dict) else {}
+        return self.submit(
+            kw["prompts"][0], max_new_tokens,
+            tenant=str(meta.get("tenant", "default")),
+            priority=int(meta.get("priority", 0)),
+            deadline_s=kw["deadline_s"][0], stop_tokens=stop_tokens,
+            request_id=request_id)
+
+    @property
+    def closed(self) -> bool:
+        """True once the engine stopped serving — explicit :meth:`close`
+        or an unrecoverable device failure. The fleet router polls this
+        to mark replicas dead (see serving/fleet/router.py)."""
+        return self._closed
+
+    @property
+    def load(self):
+        """(queued requests, active requests) — the same numbers
+        :meth:`debug_state` reports, without building the full
+        post-mortem snapshot. The fleet router's per-submit routing
+        tie-break reads this."""
+        return (self.queue.depth, len(self._active))
+
     @property
     def has_work(self) -> bool:
         return bool(self._active) or self.queue.depth > 0

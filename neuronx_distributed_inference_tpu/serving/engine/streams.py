@@ -81,6 +81,12 @@ class TokenStream:
         budget checks use this instead of copying ``tokens``."""
         return len(self._tokens)
 
+    def tokens_from(self, start: int) -> List[int]:
+        """Tokens from index ``start`` on, without copying the whole
+        stream (the fleet router's per-pass pump reads only the new
+        tail; does not move the consumer cursor)."""
+        return self._tokens[start:]
+
     @property
     def finished(self) -> bool:
         return self.finish_reason is not None

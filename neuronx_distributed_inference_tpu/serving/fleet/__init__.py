@@ -1,0 +1,34 @@
+"""Fleet layer — the tier above one ServingEngine (ROADMAP item 3).
+
+Three composable prongs, all CPU-verifiable (README "Fleet" is the
+contract):
+
+  * :class:`~.router.EngineRouter` — spreads requests across N
+    :class:`~..engine.scheduler.ServingEngine` replicas with
+    prefix-affinity routing (warmest ``prefix_warmth``, tie-broken by
+    least queue depth from ``debug_state()``), per-replica
+    healthy/draining/dead states with ``drain()``, and
+    requeue-on-replica-failure riding the ``Preempted`` requeue contract
+    (failover streams stay bit-identical under greedy decoding);
+  * :class:`~.kv_tier.HostKVSpillTier` — a bounded host-RAM tier under
+    the device block pool: LRU-evicted prefix blocks spill their
+    payloads host-side (content-hash keyed) and re-admit via async H2D
+    restore instead of recompute-prefill;
+  * :mod:`~.handoff` — disaggregated prefill: a prefill-role engine
+    captures a JSON-safe handoff record (serialized ``Preempted`` + the
+    spilled KV block payloads) that a decode-role engine admits through
+    the ordinary transactional ``add_requests`` path, bit-identical to a
+    single-engine run.
+"""
+
+from .handoff import (HANDOFF_SCHEMA, admit_handoff, capture_handoff,
+                      handoff_from_json, handoff_to_json)
+from .kv_tier import HostKVSpillTier
+from .router import DEAD, DRAINING, HEALTHY, EngineRouter
+
+__all__ = [
+    "EngineRouter", "HEALTHY", "DRAINING", "DEAD",
+    "HostKVSpillTier",
+    "HANDOFF_SCHEMA", "capture_handoff", "admit_handoff",
+    "handoff_to_json", "handoff_from_json",
+]

@@ -1,0 +1,223 @@
+"""Disaggregated prefill → decode handoff (ROADMAP item 3 prong c).
+
+A **prefill-role** engine runs admission + chunked prefill only (any
+:class:`~..adapter.PagedEngineAdapter`); once a sequence's first token
+has materialized, :func:`capture_handoff` snapshots it into a JSON-safe
+**handoff record** — a superset of the serialized
+:class:`~...resilience.preemption.Preempted` requeue payload plus the
+sequence's fully-written KV block payloads (content-chain-hash keyed,
+read device→host) — and releases it from the prefill engine.
+
+A **decode-role** engine admits the record with :func:`admit_handoff`:
+the block payloads seed its :class:`~.kv_tier.HostKVSpillTier`, and the
+record's recompute prompt goes through the ordinary transactional
+``add_requests`` path, whose spill-restore step re-admits the KV by
+async H2D copy instead of recompute-prefill. Because the record's tokens
+ride the exact ``Preempted`` replay contract (prompt + every sampled
+token; the last sampled token's KV intentionally unwritten), the decode
+engine's greedy continuation is **bit-identical to a single-engine run**
+(pinned by ``tests/test_fleet.py``).
+
+The record is pure JSON (payloads base64-encoded with dtype/shape), so
+it crosses process boundaries: ``json.dumps(handoff_to_json(rec))`` on
+the prefill host, ``handoff_from_json(json.loads(...))`` on the decode
+host. Failures are typed :class:`~...resilience.errors.HandoffError`
+with the failing side's engine state unchanged (capture reads before it
+releases; admission is transactional), and the ``handoff`` fault point
+makes both sides' failure paths deterministic in tests.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ...resilience.errors import HandoffError, ServingError
+from ...resilience.faults import FAULTS as _FAULTS
+from ...resilience.preemption import Preempted
+from ...telemetry import get_registry
+from ...telemetry import metrics as tmetrics
+from ...telemetry.trace import get_recorder as _get_recorder
+
+__all__ = ["HANDOFF_SCHEMA", "capture_handoff", "admit_handoff",
+           "handoff_to_json", "handoff_from_json"]
+
+HANDOFF_SCHEMA = "nxdi-handoff-v1"
+
+
+def capture_handoff(adapter, seq_id: int,
+                    now: Optional[float] = None) -> Dict[str, Any]:
+    """Snapshot one RUNNING sequence of a prefill-role adapter into a
+    handoff record and release it. The record holds the serialized
+    ``Preempted`` payload (tokens = prompt + everything sampled,
+    remaining deadline budget, meta passthrough) plus the K/V payloads of
+    every fully-written block (positions ``[0, position)`` — the last
+    sampled token's KV is intentionally absent, exactly like a
+    preemption-requeue). Raises :class:`HandoffError` for a pending
+    (mid-prefill) or unknown seq_id, leaving the adapter unchanged."""
+    st = adapter.seqs.get(seq_id)
+    if st is None:
+        state = ("still mid-prefill" if seq_id in getattr(
+            adapter, "_chunks", {}) else "not running")
+        raise HandoffError(
+            f"cannot capture seq_id {seq_id}: {state} — hand off after "
+            "its first token materializes", seq_ids=(seq_id,))
+    mgr = adapter.app.kv_mgr
+    bs = mgr.spec.block_size
+    table = mgr.tables[seq_id]
+    try:
+        if _FAULTS.active:
+            _FAULTS.fire("handoff")
+        # full blocks whose every slot was written: (bi+1)*bs <= position
+        # (position indexes the last SAMPLED token, whose KV is unwritten)
+        cache = adapter.app.cache
+        kv_blocks = []
+        parent = b""
+        for bi in range(st.position // bs):
+            parent = _chain_hash(parent, st.tokens[bi * bs:(bi + 1) * bs])
+            blk = table[bi]
+            kv_blocks.append({
+                "hash": parent,
+                "k": np.asarray(cache["k"][:, blk]),
+                "v": np.asarray(cache["v"][:, blk]),
+            })
+    except ServingError:
+        raise
+    except Exception as e:
+        raise HandoffError(
+            f"handoff capture of seq_id {seq_id} failed; the sequence "
+            "is still running on the prefill engine",
+            seq_ids=(seq_id,)) from e
+    pre = Preempted(
+        seq_id=seq_id, tokens=tuple(st.tokens), prompt_len=st.prompt_len,
+        n_generated=len(st.tokens) - st.prompt_len, reason="handoff",
+        deadline=st.deadline, meta=st.meta)
+    adapter.release([seq_id])
+    record = {
+        "schema": HANDOFF_SCHEMA,
+        "preempted": pre.to_json(now=now),
+        "block_size": bs,
+        "kv_blocks": kv_blocks,
+    }
+    rec = _get_recorder()
+    if rec.enabled:
+        rec.instant("handoff.send", cat="fleet", seq_id=int(seq_id),
+                    tokens=len(pre.tokens), blocks=len(kv_blocks),
+                    engine=adapter.engine_name)
+    reg = get_registry()
+    if reg.enabled:
+        tmetrics.handoffs_counter(reg).inc(role="send")
+    return record
+
+
+def admit_handoff(adapter, record: Dict[str, Any], seq_id: int,
+                  now: Optional[float] = None) -> Dict[int, int]:
+    """Admit a handoff record on a decode-role adapter: seed its spill
+    tier with the record's block payloads, then run the ordinary
+    transactional ``add_requests`` — the spill-restore step re-admits the
+    KV via H2D copy and only the uncovered suffix recomputes. Returns the
+    adapter's first-token dict (``{}`` under a deferred prefill budget).
+    Raises :class:`HandoffError` for a malformed record or a decode
+    adapter without a spill tier; admission failures propagate typed with
+    the decode engine rolled back (transactional)."""
+    tier = getattr(adapter, "_kv_tier", None)
+    if tier is None:
+        raise HandoffError(
+            "decode-role adapter has no kv_spill_tier — build it with "
+            "PagedEngineAdapter(app, kv_spill_tier=HostKVSpillTier(...)) "
+            "so the handoff KV can be restored instead of recomputed")
+    try:
+        if _FAULTS.active:
+            _FAULTS.fire("handoff")
+        if record.get("schema") != HANDOFF_SCHEMA:
+            raise KeyError(f"not an {HANDOFF_SCHEMA} record: "
+                           f"schema={record.get('schema')!r}")
+        if int(record["block_size"]) != adapter.app.kv_mgr.spec.block_size:
+            raise KeyError(
+                f"handoff block_size {record['block_size']} != decode "
+                f"engine's {adapter.app.kv_mgr.spec.block_size}")
+        pre = Preempted.from_json(record["preempted"], now=now)
+        payloads = {b["hash"]: {"k": b["k"], "v": b["v"]}
+                    for b in record["kv_blocks"]}
+    except ServingError:
+        raise
+    except Exception as e:
+        raise HandoffError(
+            f"handoff admission failed before any decode-engine state "
+            f"changed: {e}") from e
+    tier.seed(payloads)
+    first = adapter.add_requests(**pre.admission_kwargs(seq_id=seq_id,
+                                                        now=now))
+    rec = _get_recorder()
+    if rec.enabled:
+        rec.instant("handoff.recv", cat="fleet", seq_id=int(seq_id),
+                    tokens=len(pre.tokens), blocks=len(payloads),
+                    engine=adapter.engine_name)
+    reg = get_registry()
+    if reg.enabled:
+        tmetrics.handoffs_counter(reg).inc(role="recv")
+    return first
+
+
+# ---------------------------------------------------------------------------
+# JSON wire format (cross-process)
+# ---------------------------------------------------------------------------
+
+def handoff_to_json(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Pure-JSON form of a handoff record: block payloads become base64
+    raw bytes + dtype/shape (bfloat16 and friends round-trip via
+    ml_dtypes names), hashes become hex strings."""
+    out = dict(record)
+    blocks = []
+    for b in record["kv_blocks"]:
+        k, v = np.asarray(b["k"]), np.asarray(b["v"])
+        blocks.append({
+            "hash": b["hash"].hex(),
+            "dtype": k.dtype.name,
+            "shape": list(k.shape),
+            "k": base64.b64encode(k.tobytes()).decode("ascii"),
+            "v": base64.b64encode(v.tobytes()).decode("ascii"),
+        })
+    out["kv_blocks"] = blocks
+    return out
+
+
+def handoff_from_json(data: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`handoff_to_json`. Raises
+    :class:`HandoffError` on malformed input."""
+    try:
+        out = dict(data)
+        blocks = []
+        for b in data["kv_blocks"]:
+            dtype = _np_dtype(b["dtype"])
+            shape = tuple(int(s) for s in b["shape"])
+            blocks.append({
+                "hash": bytes.fromhex(b["hash"]),
+                "k": np.frombuffer(base64.b64decode(b["k"]),
+                                   dtype=dtype).reshape(shape),
+                "v": np.frombuffer(base64.b64decode(b["v"]),
+                                   dtype=dtype).reshape(shape),
+            })
+        out["kv_blocks"] = blocks
+        return out
+    except HandoffError:
+        raise
+    except Exception as e:
+        raise HandoffError(f"malformed handoff JSON: {e}") from e
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype from its name, reaching into ml_dtypes for the
+    accelerator dtypes numpy itself does not know (bfloat16, fp8)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _chain_hash(parent: bytes, tokens) -> bytes:
+    from ...modules.block_kv_cache import _hash_block
+    return _hash_block(parent, list(tokens))

@@ -1,0 +1,372 @@
+"""Replicated-engine router — the fleet front tier (ROADMAP item 3
+prong a).
+
+An :class:`EngineRouter` owns N named :class:`~..engine.scheduler.
+ServingEngine` replicas and presents one submit/stream surface above
+them:
+
+  * **prefix-affinity routing** — a request goes to the HEALTHY replica
+    whose ``adapter.prefix_warmth(tokens)`` is highest (with a host KV
+    spill tier attached, spilled warmth counts too), tie-broken by least
+    load read from the replica's ``debug_state()`` (queue depth, then
+    active requests) — the same snapshot ``GET /v1/debug/state`` serves;
+  * **health states** — ``healthy`` (routable), ``draining`` (no new
+    admissions, running/queued work finishes; :meth:`drain` /
+    :meth:`undrain`), ``dead`` (failed or closed; never routed again).
+    A replica whose engine raises an unrecoverable
+    :class:`~...resilience.errors.StepFailure` — or turns up closed — is
+    marked dead automatically by :meth:`run_pass`;
+  * **requeue on replica failure** — every in-flight request of a dead
+    replica is re-submitted to a surviving one riding the
+    :class:`~...resilience.preemption.Preempted` requeue contract
+    (``admission_kwargs()``): the recompute prompt is the original
+    prompt plus every token already delivered, so under greedy decoding
+    the stitched fleet stream is bit-identical to an uninterrupted run
+    (pinned by ``tests/test_fleet.py``).
+
+The router is synchronous like the engine (:meth:`run_pass` /
+:meth:`run_until_drained` drive it); callers get ordinary
+:class:`~..engine.streams.TokenStream` objects whose tokens survive
+failovers. Routing/drain/requeue decisions land on the flight recorder
+(``fleet.route`` / ``fleet.drain``) and the ``nxdi_fleet_*`` metrics.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from ...resilience.errors import (ConfigurationError, ReplicaUnavailable,
+                                  ServingError, StepFailure)
+from ...resilience.preemption import Preempted
+from ...telemetry import get_registry
+from ...telemetry import metrics as tmetrics
+from ...telemetry.trace import get_recorder as _get_recorder
+from ..engine.streams import TokenStream
+
+__all__ = ["EngineRouter", "HEALTHY", "DRAINING", "DEAD"]
+
+#: Replica health states (the README "Fleet" contract):
+#:   healthy  — routable for new admissions
+#:   draining — no new admissions; running AND already-queued work
+#:              finishes normally (``undrain`` returns it to healthy)
+#:   dead     — failed (unrecoverable StepFailure) or closed; its
+#:              in-flight requests were requeued elsewhere
+HEALTHY, DRAINING, DEAD = "healthy", "draining", "dead"
+
+
+@dataclass
+class _Replica:
+    name: str
+    engine: Any
+    state: str = HEALTHY
+
+
+@dataclass
+class _FleetRequest:
+    """Router-side record of one request: the immutable spec plus the
+    mutable binding to whichever replica currently serves it."""
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    tenant: str
+    priority: int
+    deadline: Optional[float]          # absolute perf_counter(), or None
+    stop_tokens: tuple
+    stream: TokenStream                # the fleet-level stream
+    replica: str = ""
+    inner: Optional[TokenStream] = None
+    pumped: int = 0                    # tokens taken from current inner
+    n_requeues: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class EngineRouter:
+    """Prefix-affinity router over named ServingEngine replicas.
+
+    ``replicas`` maps replica name -> engine (an iterable of engines gets
+    auto-names ``r0..rN-1``). ``max_requeues`` bounds how many replica
+    failures one request may survive before its stream fails typed."""
+
+    def __init__(self, replicas, *, max_requeues: int = 2):
+        if not isinstance(replicas, dict):
+            replicas = {f"r{i}": e for i, e in enumerate(replicas)}
+        if not replicas:
+            raise ConfigurationError("EngineRouter needs >= 1 replica")
+        for name, eng in replicas.items():
+            if not hasattr(eng, "run_pass") or not hasattr(eng, "adapter"):
+                raise ConfigurationError(
+                    f"replica {name!r} is not a ServingEngine surface")
+        self.replicas: Dict[str, _Replica] = {
+            name: _Replica(name, eng) for name, eng in replicas.items()}
+        self.max_requeues = max_requeues
+        self._requests: Dict[str, _FleetRequest] = {}
+        self._done: List[str] = []     # newest finished ids (bounded)
+        self._rid_counter = itertools.count()
+        self.stats: Dict[str, int] = {
+            "routed": 0, "affinity_warm": 0, "affinity_cold": 0,
+            "requeues": 0, "replica_failures": 0, "completed": 0,
+            "drains": 0}
+
+    # -- public surface ----------------------------------------------------
+    def submit(self, tokens: Sequence[int], max_new_tokens: int, *,
+               tenant: str = "default", priority: int = 0,
+               deadline_s: Optional[float] = None,
+               stop_tokens: Sequence[int] = (),
+               request_id: Optional[str] = None) -> TokenStream:
+        """Route one request to the warmest healthy replica and return
+        its fleet-level :class:`TokenStream` (tokens survive replica
+        failovers). Raises :class:`ReplicaUnavailable` when no replica is
+        healthy; replica-side admission errors propagate unchanged."""
+        tokens = [int(t) for t in tokens]
+        rid = (request_id if request_id is not None
+               else f"f{next(self._rid_counter)}")
+        if rid in self._requests:
+            raise ServingError(f"request_id {rid!r} already in flight")
+        now = time.perf_counter()
+        req = _FleetRequest(
+            request_id=rid, prompt=tokens, max_new_tokens=max_new_tokens,
+            tenant=tenant, priority=priority,
+            deadline=None if deadline_s is None else now + deadline_s,
+            stop_tokens=tuple(int(t) for t in stop_tokens),
+            stream=TokenStream(rid, tenant))
+        name, warmth = self._pick(tokens)
+        rep = self.replicas[name]
+        req.inner = rep.engine.submit(
+            tokens, max_new_tokens, tenant=tenant, priority=priority,
+            deadline_s=deadline_s, stop_tokens=stop_tokens,
+            request_id=rid)
+        req.replica = name
+        req.stream._cancel_cb = lambda: self.cancel(rid)
+        self._requests[rid] = req
+        self._note_route(req, name, warmth, requeue=False)
+        return req.stream
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel wherever the request currently lives; returns False for
+        unknown/finished ids."""
+        req = self._requests.get(request_id)
+        if req is None or req.stream.finished:
+            return False
+        rep = self.replicas.get(req.replica)
+        if rep is not None and rep.state != DEAD:
+            rep.engine.cancel(request_id)
+        self._finish(req, "cancelled", req.stream.cancelled_error())
+        return True
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._requests)
+
+    def run_pass(self) -> int:
+        """One fleet pass: drive every live replica's scheduling pass
+        (marking failed/closed ones dead), then pump replica streams into
+        the fleet streams — requeueing any request whose replica died.
+        Returns tokens delivered to fleet streams."""
+        for rep in list(self.replicas.values()):
+            if rep.state == DEAD:
+                continue
+            if getattr(rep.engine, "closed", False):
+                self._mark_dead(rep, reason="closed")
+                continue
+            try:
+                rep.engine.run_pass()
+            except StepFailure as e:
+                if e.retry_safe:
+                    continue           # engine retries next pass
+                self._mark_dead(rep, reason="step_failure")
+        delivered = 0
+        for req in list(self._requests.values()):
+            delivered += self._pump(req)
+        return delivered
+
+    def run_until_drained(self, max_passes: int = 100000) -> None:
+        passes = 0
+        while self.has_work:
+            self.run_pass()
+            passes += 1
+            if passes >= max_passes:
+                raise ServingError(
+                    f"fleet made no progress in {max_passes} passes — "
+                    "router wedged (file a bug with the fleet stats)")
+
+    # -- health ------------------------------------------------------------
+    def drain(self, name: str) -> None:
+        """Stop routing NEW requests to ``name``; running and queued work
+        finishes normally. Idempotent; a dead replica stays dead."""
+        rep = self._replica(name)
+        if rep.state != HEALTHY:
+            return
+        rep.state = DRAINING
+        self.stats["drains"] += 1
+        self._trace_state(rep, reason="drain")
+
+    def undrain(self, name: str) -> None:
+        """Return a draining replica to healthy (dead ones stay dead)."""
+        rep = self._replica(name)
+        if rep.state == DRAINING:
+            rep.state = HEALTHY
+            self._trace_state(rep, reason="undrain")
+
+    def _replica(self, name: str) -> _Replica:
+        rep = self.replicas.get(name)
+        if rep is None:
+            raise ConfigurationError(f"unknown replica {name!r}; have "
+                                     f"{sorted(self.replicas)}")
+        return rep
+
+    def _mark_dead(self, rep: _Replica, reason: str) -> None:
+        if rep.state == DEAD:
+            return
+        rep.state = DEAD
+        self.stats["replica_failures"] += 1
+        self._trace_state(rep, reason=reason)
+
+    def _trace_state(self, rep: _Replica, reason: str) -> None:
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("fleet.drain", cat="fleet", replica=rep.name,
+                        state=rep.state, reason=reason)
+
+    # -- routing -----------------------------------------------------------
+    def _pick(self, tokens: Sequence[int]):
+        """(replica name, its warmth) for a new admission: warmest
+        prefix first, then least load (queue depth, then active count —
+        the same numbers ``debug_state()`` serves, read through the
+        lightweight ``ServingEngine.load`` accessor), then stable name
+        order. A replica whose engine turns up closed is marked dead
+        here rather than routed to (its in-flight work fails over on the
+        next pass)."""
+        best = None
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if rep.state != HEALTHY:
+                continue
+            if getattr(rep.engine, "closed", False):
+                self._mark_dead(rep, reason="closed")
+                continue
+            try:
+                warmth = int(rep.engine.adapter.prefix_warmth(tokens))
+            except ServingError:
+                warmth = 0
+            load = getattr(rep.engine, "load", None)
+            if load is None:           # foreign engine surface
+                ds = rep.engine.debug_state()
+                load = (ds["queue"]["depth"], len(ds["active"]))
+            key = (-warmth, tuple(load), name)
+            if best is None or key < best[0]:
+                best = (key, name, warmth)
+        if best is None:
+            raise ReplicaUnavailable(
+                "no healthy replica (all draining or dead) — shed or "
+                "retry elsewhere")
+        return best[1], best[2]
+
+    def _note_route(self, req: _FleetRequest, name: str, warmth: int,
+                    requeue: bool) -> None:
+        self.stats["routed"] += 1
+        affinity = "warm" if warmth > 0 else "cold"
+        self.stats[f"affinity_{affinity}"] += 1
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("fleet.route", cat="fleet",
+                        request_id=req.request_id, replica=name,
+                        warmth=warmth, affinity=affinity, requeue=requeue)
+        reg = get_registry()
+        if reg.enabled:
+            tmetrics.fleet_routed_counter(reg).inc(replica=name,
+                                                   affinity=affinity)
+
+    # -- delivery / failover -----------------------------------------------
+    def _pump(self, req: _FleetRequest) -> int:
+        """Move newly generated tokens from the replica stream into the
+        fleet stream; forward normal finishes; requeue when the replica
+        FAILED the request — an error finish, or a "cancelled" finish
+        issued by a dead/closed replica's teardown (a router-initiated
+        cancel finishes the FLEET stream first, so it never reaches
+        here)."""
+        inner = req.inner
+        if inner is None or req.stream.finished:
+            return 0
+        n_new = inner.n_tokens - req.pumped      # O(1) idle-pass check
+        if n_new:
+            for tok in inner.tokens_from(req.pumped):
+                req.stream.put(tok)
+            req.pumped += n_new
+        if inner.finished:
+            replica_lost = (inner.finish_reason == "cancelled"
+                            and self.replicas[req.replica].state == DEAD)
+            if inner.finish_reason == "error" or replica_lost:
+                self._requeue(req, inner.error)
+            else:
+                self._finish(req, inner.finish_reason, inner.error)
+        return n_new
+
+    def _requeue(self, req: _FleetRequest, cause) -> None:
+        """Failover one request whose replica died: re-submit prompt +
+        delivered tokens (the :class:`Preempted` recompute contract) to a
+        surviving replica with the remaining token budget."""
+        failed = req.replica
+        if req.n_requeues >= self.max_requeues:
+            self._finish(req, "error", cause)
+            return
+        delivered = req.stream.n_tokens
+        remaining = req.max_new_tokens - delivered
+        if remaining <= 0:              # budget met just as the replica died
+            self._finish(req, "length")
+            return
+        rec = Preempted(
+            seq_id=-1, tokens=tuple(req.prompt + req.stream.tokens),
+            prompt_len=len(req.prompt), n_generated=delivered,
+            reason="replica_failure", deadline=req.deadline,
+            meta={"request_id": req.request_id, "tenant": req.tenant,
+                  "priority": req.priority})
+        try:
+            name, warmth = self._pick(rec.tokens)
+            req.inner = self.replicas[name].engine.submit_record(
+                rec, remaining, stop_tokens=req.stop_tokens,
+                request_id=req.request_id)
+        except ServingError as e:
+            self._finish(req, "error", e)
+            return
+        req.replica = name
+        req.pumped = 0
+        req.n_requeues += 1
+        self.stats["requeues"] += 1
+        self._note_route(req, name, warmth, requeue=True)
+        reg = get_registry()
+        if reg.enabled:
+            tmetrics.fleet_requeues_counter(reg).inc(replica=failed)
+
+    def _finish(self, req: _FleetRequest, reason: str,
+                error=None) -> None:
+        req.stream.finish(reason, error)
+        self._requests.pop(req.request_id, None)
+        self._done.append(req.request_id)
+        del self._done[:-256]          # bounded, like the stream registry
+        if reason in ("length", "stop"):
+            self.stats["completed"] += 1
+
+    # -- observability -----------------------------------------------------
+    def debug_state(self) -> Dict[str, Any]:
+        """JSON-able fleet snapshot — served as the ``fleet`` section of
+        ``GET /v1/debug/state`` when the frontend is built with
+        ``fleet=``: per-replica health + load, router stats, and the
+        in-flight request → replica binding."""
+        replicas = {}
+        for name, rep in self.replicas.items():
+            entry: Dict[str, Any] = {"state": rep.state}
+            if rep.state != DEAD:
+                ds = rep.engine.debug_state()
+                entry.update(queue_depth=ds["queue"]["depth"],
+                             active=len(ds["active"]),
+                             closed=ds["closed"])
+            replicas[name] = entry
+        return {
+            "replicas": replicas,
+            "stats": dict(self.stats),
+            "in_flight": {rid: req.replica
+                          for rid, req in self._requests.items()},
+        }

@@ -78,6 +78,18 @@ SPEC_ACCEPTED_TOKENS_TOTAL = "nxdi_spec_accepted_tokens_total"   # engine
 SPEC_ACCEPT_RATE = "nxdi_spec_accept_rate"                       # engine
 SPEC_VERIFY_WIDTH = "nxdi_spec_verify_width"                     # engine
 
+# -- fleet layer (serving/fleet/) --------------------------------------------
+FLEET_ROUTED_TOTAL = "nxdi_fleet_routed_total"       # replica, affinity
+FLEET_REQUEUES_TOTAL = "nxdi_fleet_requeues_total"   # replica
+HANDOFFS_TOTAL = "nxdi_handoff_total"                # role=send|recv
+
+# -- host-RAM KV spill tier (serving/fleet/kv_tier.py) -----------------------
+KV_SPILL_BLOCKS_TOTAL = "nxdi_kv_spill_blocks_total"
+KV_SPILL_EVICTIONS_TOTAL = "nxdi_kv_spill_evictions_total"
+KV_SPILL_BYTES = "nxdi_kv_spill_bytes"
+KV_RESTORE_BLOCKS_TOTAL = "nxdi_kv_restore_blocks_total"
+KV_RESTORE_TOKENS_TOTAL = "nxdi_kv_restore_tokens_total"
+
 # -- degradations -----------------------------------------------------------
 MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
     "nxdi_moe_tkg_local_quant_degraded_total"
@@ -371,6 +383,66 @@ def spec_verify_width_histogram(reg):
         "Bucketed candidate width (drafts + 1) of each speculative verify "
         "dispatch — width 1 means the step degenerated to eager decode",
         labels=("engine",), buckets=(1, 2, 4, 8, 16, 32))
+
+
+def fleet_routed_counter(reg):
+    return reg.counter(
+        FLEET_ROUTED_TOTAL,
+        "Requests routed to a replica by the fleet EngineRouter "
+        "(affinity=warm when prefix-affinity picked the replica, cold "
+        "when it fell through to least queue depth)",
+        labels=("replica", "affinity"))
+
+
+def fleet_requeues_counter(reg):
+    return reg.counter(
+        FLEET_REQUEUES_TOTAL,
+        "In-flight requests requeued onto another replica after their "
+        "replica failed or closed (labeled with the FAILED replica)",
+        labels=("replica",))
+
+
+def handoffs_counter(reg):
+    return reg.counter(
+        HANDOFFS_TOTAL,
+        "Disaggregated prefill/decode handoffs (role=send on capture, "
+        "role=recv on decode-side admission)",
+        labels=("role",))
+
+
+def kv_spill_blocks_counter(reg):
+    return reg.counter(
+        KV_SPILL_BLOCKS_TOTAL,
+        "KV block payloads spilled from device to the host-RAM tier "
+        "(on prefix-cache LRU eviction)")
+
+
+def kv_spill_evictions_counter(reg):
+    return reg.counter(
+        KV_SPILL_EVICTIONS_TOTAL,
+        "Block payloads evicted from the bounded host-RAM spill tier "
+        "(oldest-touched first) — nonzero means the tier is undersized "
+        "for the working set")
+
+
+def kv_spill_bytes_gauge(reg):
+    return reg.gauge(
+        KV_SPILL_BYTES,
+        "Host RAM currently held by the KV spill tier's block payloads")
+
+
+def kv_restore_blocks_counter(reg):
+    return reg.counter(
+        KV_RESTORE_BLOCKS_TOTAL,
+        "Spilled KV blocks restored to device by H2D copy at admission "
+        "(each one replaces a recompute of block_size prompt tokens)")
+
+
+def kv_restore_tokens_counter(reg):
+    return reg.counter(
+        KV_RESTORE_TOKENS_TOTAL,
+        "Prompt tokens whose prefill recompute was replaced by a "
+        "spill-tier restore")
 
 
 def moe_tkg_degraded_counter(reg):

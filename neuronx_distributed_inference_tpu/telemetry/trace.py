@@ -44,7 +44,7 @@ from .registry import get_registry
 
 __all__ = [
     "ENGINE_PASS_PHASES", "ENGINE_EVENTS", "ADAPTER_EVENTS", "APP_EVENTS",
-    "EVENT_NAMES",
+    "FLEET_EVENTS", "EVENT_NAMES",
     "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
     "get_recorder", "set_recorder", "enable_recorder", "disable_recorder",
 ]
@@ -74,10 +74,24 @@ ADAPTER_EVENTS = ("dispatch.decode", "dispatch.decode_loop",
 APP_EVENTS = ("run.prefill", "run.decode", "run.decode_loop", "run.paged",
               "run.paged_loop", "compile")
 
-EVENT_NAMES = ENGINE_PASS_PHASES + ENGINE_EVENTS + ADAPTER_EVENTS + APP_EVENTS
+#: Fleet-layer events (serving/fleet/). STABLE names.
+#:   ``fleet.route``    one request routed to a replica (request_id,
+#:                      replica, warmth, affinity)
+#:   ``fleet.drain``    a replica transitioned to draining/dead
+#:                      (replica, state, reason)
+#:   ``kv.spill``       one block payload spilled to the host-RAM tier
+#:   ``kv.restore``     spilled block payloads restored to device at
+#:                      admission (seq_id, blocks, tokens)
+#:   ``handoff.send``   a prefill-role engine captured a handoff record
+#:   ``handoff.recv``   a decode-role engine admitted a handoff record
+FLEET_EVENTS = ("fleet.route", "fleet.drain", "kv.spill", "kv.restore",
+                "handoff.send", "handoff.recv")
+
+EVENT_NAMES = (ENGINE_PASS_PHASES + ENGINE_EVENTS + ADAPTER_EVENTS
+               + APP_EVENTS + FLEET_EVENTS)
 
 #: Category -> Chrome trace tid lane (deterministic ordering in the UI).
-_CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4}
+_CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4, "fleet": 5}
 
 
 class _TraceSpan:
