@@ -298,6 +298,125 @@ def spec_overhead_main(artifact_path="artifacts/bench_spec_r10.json"):
     _emit_report_artifact(payload, artifact_path, "spec-overhead")
 
 
+def ragged_overhead_main(artifact_path="artifacts/bench_ragged_r13.json"):
+    """CPU-runnable ragged-dispatch microbench (ISSUE 13): drives the
+    SAME staggered mixed workload — two short prompts decoding, then the
+    8/120 skewed pair of bench_prefill admitted mid-decode, self-draft
+    speculation k=3 throughout — through the two-phase paged adapter
+    (at most one packed chunk dispatch, then one draft + one verify
+    dispatch per engine step) and through ragged mode (ONE unified mixed
+    dispatch per step, serving/ragged/). Reports dispatches and
+    materialized (blocking-fetch) dispatches per engine step, plus
+    prompt-token pad waste per ladder: the old ctx-sliced chunk ladder
+    vs the unified ``ragged_row_buckets`` ladder (whose sub-ctx rungs
+    let a trailing partial chunk pad to 8 instead of 16). Streams are
+    asserted bit-identical across the modes, so the structural numbers
+    compare the same tokens. One parseable JSON line + an artifact
+    file, no TPU required."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.speculation import \
+        SelfDraftProposer
+
+    hf = _tiny_llama_hf()
+    tcfg = TpuConfig(batch_size=4, seq_len=192, dtype="float32",
+                     enable_bucketing=True, enable_2d_bucketing=True,
+                     context_encoding_buckets=[16, 32, 64, 128],
+                     is_block_kv_layout=True, pa_block_size=16,
+                     pa_num_blocks=64, is_prefix_caching=False)
+    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                   LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    rng = np.random.default_rng(0)
+    warm = [rng.integers(1, 500, size=n).tolist() for n in (8, 12)]
+    skew = [rng.integers(1, 500, size=n).tolist() for n in (8, 120)]
+    want = 12                       # tokens per stream
+
+    def run(ragged):
+        eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3),
+                                 prefill_chunk_tokens=16,
+                                 prefill_budget_tokens=16, ragged=ragged)
+        base = dict(eng.host_stats)
+        got = {s: [] for s in range(4)}
+        steps = 0
+
+        def drive(ids, n):
+            nonlocal steps
+            while any(len(got[s]) < n for s in ids):
+                for s, toks in eng.step().items():
+                    toks = toks if isinstance(toks, list) else [toks]
+                    got[s].extend(toks)
+                steps += 1
+                assert steps < 400, "mixed workload made no progress"
+
+        t0 = time.perf_counter()
+        eng.add_requests([0, 1], warm)
+        drive((0, 1), 4)
+        eng.add_requests([2, 3], skew)   # mid-decode: mixed load begins
+        drive(range(4), want)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        stats = {k: eng.host_stats[k] - base.get(k, 0)
+                 for k in eng.host_stats}
+        eng.release(range(4))
+        materialized = (stats["blocking_fetches"]
+                        + stats["prefill_blocking_fetches"])
+        out = {
+            "engine_steps": steps,
+            "dispatches": stats["dispatches"]
+            + stats["prefill_dispatches"],
+            "materialized_dispatches": materialized,
+            "materialized_per_step": round(materialized / steps, 3),
+            "dispatches_per_step": round(
+                (stats["dispatches"] + stats["prefill_dispatches"])
+                / steps, 3),
+            "prefill_pad_waste": round(
+                1.0 - stats["prefill_real_tokens"]
+                / max(stats["prefill_padded_tokens"], 1), 4),
+            "wall_ms": round(wall_ms, 2),
+        }
+        if ragged:
+            out["ragged_pad_waste_total"] = round(
+                1.0 - stats["ragged_real_tokens"]
+                / max(stats["ragged_padded_tokens"], 1), 4)
+        return out, got
+
+    for mode in (False, True):
+        run(mode)                      # warm: compile every graph
+    two_phase, ref = run(False)
+    ragged, got = run(True)
+    assert all(got[s][:want] == ref[s][:want] for s in range(4)), \
+        "ragged streams diverged from the two-phase path"
+    payload = {
+        "metric": "ragged_materialized_dispatches_per_engine_step",
+        "value": ragged["materialized_per_step"],
+        "unit": "materialized_dispatches_per_step_mixed_load",
+        "details": {
+            "two_phase": two_phase,
+            "ragged": ragged,
+            "pad_waste_ladders": {
+                "prefill_chunk_ladder_two_phase":
+                    two_phase["prefill_pad_waste"],
+                "unified_ragged_ladder": ragged["prefill_pad_waste"],
+            },
+            "streams_bit_identical": True,
+            "speculation": "self-draft k=3 (accept 1.0)",
+            "prompt_lens": [len(p) for p in warm + skew],
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "ragged-overhead")
+
+
 def serving_load_main(artifact_path="artifacts/bench_serving_r08.json"):
     """CPU-runnable closed-loop serving-load microbench (ISSUE 6): drives
     the multi-tenant ServingEngine over the paged adapter with a 2x
@@ -748,6 +867,7 @@ def _no_tpu_fallback(error: str):
     for name, fn in (("host_overhead", host_overhead_main),
                      ("prefill_overhead", prefill_overhead_main),
                      ("spec_overhead", spec_overhead_main),
+                     ("ragged_overhead", ragged_overhead_main),
                      ("serving_load", serving_load_main),
                      ("fleet_load", fleet_load_main),
                      ("graph_report", graph_report_main),
@@ -796,6 +916,8 @@ def main():
         return prefill_overhead_main()
     if "--spec-overhead" in sys.argv[1:]:
         return spec_overhead_main()
+    if "--ragged-overhead" in sys.argv[1:]:
+        return ragged_overhead_main()
     if "--serving-load" in sys.argv[1:]:
         return serving_load_main()
     if "--fleet-load" in sys.argv[1:]:

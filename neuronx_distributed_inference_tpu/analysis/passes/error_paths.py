@@ -26,6 +26,9 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/speculation/__init__.py",
     "neuronx_distributed_inference_tpu/serving/speculation/proposer.py",
     "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
+    "neuronx_distributed_inference_tpu/serving/ragged/__init__.py",
+    "neuronx_distributed_inference_tpu/serving/ragged/planner.py",
+    "neuronx_distributed_inference_tpu/serving/ragged/path.py",
     "neuronx_distributed_inference_tpu/serving/fleet/__init__.py",
     "neuronx_distributed_inference_tpu/serving/fleet/router.py",
     "neuronx_distributed_inference_tpu/serving/fleet/kv_tier.py",

@@ -39,6 +39,8 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/adapter.py",
     "neuronx_distributed_inference_tpu/serving/engine/scheduler.py",
     "neuronx_distributed_inference_tpu/serving/speculation/verifier.py",
+    "neuronx_distributed_inference_tpu/serving/ragged/planner.py",
+    "neuronx_distributed_inference_tpu/serving/ragged/path.py",
     "neuronx_distributed_inference_tpu/serving/fleet/router.py",
     "neuronx_distributed_inference_tpu/serving/fleet/kv_tier.py",
     "neuronx_distributed_inference_tpu/serving/fleet/handoff.py",

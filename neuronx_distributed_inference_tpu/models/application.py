@@ -1158,6 +1158,42 @@ class PagedCausalLMApplication(CausalLMApplication):
         self._tel_end("spec_verify", t0, out, input_ids.shape[0])
         return out
 
+    # -- ragged unified dispatch (serving/ragged/) -------------------------
+    def _jit_ragged(self, want_hidden: bool):
+        fn = partial(model_base.paged_ragged_step, self.spec,
+                     self.tpu_config, want_hidden=want_hidden)
+        return jax.jit(fn, donate_argnums=(1,))
+
+    def _run_ragged(self, input_ids, position_ids, slot_mapping,
+                    block_table, widths, emit_modes,
+                    want_hidden: bool = False, sampling_params=None):
+        """ONE ragged mixed dispatch (model_base.paged_ragged_step): rows
+        mix decode steps, prefill chunks and speculative verify windows,
+        each at its own offset over its own block table. ``input_ids``
+        may be a device array — verify-row drafts never round-trip
+        through the host."""
+        self._check_decode_fits(
+            int(np.max(np.asarray(position_ids)[:, 0]
+                       + np.asarray(widths))))
+        t0 = self._tel_start()
+        key = ("ragged", input_ids.shape[1], want_hidden)
+        if key not in self._compiled:
+            self._compiled[key] = self._jit_ragged(want_hidden)
+        self._note_jit("ragged", input_ids.shape[1],
+                       (input_ids.shape, block_table.shape))
+        if sampling_params is None:
+            sampling_params = self._default_sampling_params(
+                input_ids.shape[0])
+        with self._mesh_ctx():
+            out = self._compiled[key](
+                self.params, self.cache, jnp.asarray(input_ids),
+                jnp.asarray(position_ids), jnp.asarray(slot_mapping),
+                jnp.asarray(block_table), jnp.asarray(widths),
+                jnp.asarray(emit_modes), sampling_params, self._next_rng())
+        self.cache = out["cache"]
+        self._tel_end("ragged", t0, out, input_ids.shape[0])
+        return out
+
     def _bt_width(self, b: int) -> int:
         """Smallest block-table width bucket covering every live row's
         blocks (2-D prefix x prefill bucket selection)."""

@@ -2110,6 +2110,90 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     return out
 
 
+def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
+                      input_ids, position_ids, slot_mapping, block_table,
+                      widths, emit_modes, sampling_params, rng,
+                      want_hidden: bool = False):
+    """The RAGGED UNIFIED dispatch: ONE mixed paged forward whose rows mix
+    decode steps (width 1), prefill chunks (width n, positions at the
+    row's own suffix offset) and speculative verify windows (width k+1)
+    over the existing slot-mapping/block-table graph — the vLLM-class
+    shape of "Ragged Paged Attention" (arxiv 2604.15464), serving
+    serving/ragged/'s ``RaggedBatchPlanner`` (README "Ragged dispatch").
+
+    input_ids (B, W): per-kind row content — a decode row's last token in
+    column 0, a prefill row's chunk tokens, a verify row's last accepted
+    token + drafts (drafts may live on device — no host round trip).
+    position_ids (B, W) absolute; slot_mapping (B, W) flat cache slots
+    with columns >= the row's width at -1 (dropped writes); block_table
+    (B, max_blocks); widths (B,) per-row real-token counts in [1, W].
+
+    emit_modes (B,) selects each row's in-graph emission:
+
+      * 0 — emit nothing (intermediate prefill chunk; frozen/pad row):
+        ``num_emitted`` 0, KV writes still land per ``slot_mapping``.
+      * 1 — emit the row's LAST real token's sample in column 0 (decode
+        step; FINAL prefill chunk): the same ``sample_dp`` over the
+        gathered last-position logits the eager paged step applies, so
+        streams are bit-identical to :func:`paged_forward_step`.
+      * 2 — greedy exact-match acceptance over the candidate window
+        (speculative verify): identical math to
+        :func:`paged_spec_verify` — draft j accepted iff it equals the
+        target's greedy choice at the previous candidate position,
+        columns past the row's width forced mismatches, one bonus token
+        always emitted, so ``num_emitted`` is in [1, width] and the
+        emitted tokens ARE the target's greedy choices.
+
+    Returns tokens (B, W) (emitted prefix, 0 past ``num_emitted``),
+    num_emitted (B,), cache (+ hidden (B, W, H) when ``want_hidden`` —
+    Medusa/EAGLE proposers feed on the verified features).
+    """
+    if spec.mixed_kv or spec.ssm is not None:
+        raise NotImplementedError(
+            "the ragged unified dispatch over mixed per-layer / recurrent "
+            "caches is not supported; disable ragged mode for this model")
+    kv_len = block_table.shape[1] * cache["k"].shape[2]
+    ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
+        position_ids, kv_len, window=w, chunk=c))
+    hidden = _embed(spec, params, input_ids, position_ids)
+    hidden, new_cache, _ = run_layers(
+        spec, params, cache, hidden, ai, None, position_ids,
+        "paged", slot_mapping=slot_mapping, block_table=block_table)
+    logits = _lm_head(spec, params, hidden)
+    # verify-row acceptance: the same greedy the eager paged step applies
+    # (sampling_ops.sample over the untruncated head output)
+    greedy = sampling_ops.sample(logits, None, None, None)      # (B, W)
+    b, w = input_ids.shape
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    if w > 1:
+        mismatch = ((input_ids[:, 1:] != greedy[:, :-1])
+                    | (idx[:, 1:] >= widths[:, None])).astype(jnp.int32)
+        n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)
+    else:
+        n_acc = jnp.zeros((b,), jnp.int32)
+    # emit-last rows: per-row in-graph sampling at the row's last real
+    # column — the identical sample_dp call of paged_forward_step, over
+    # the last-position slice of the SAME lm_head output
+    last = jnp.maximum(widths - 1, 0).astype(jnp.int32)
+    last_logits = jnp.take_along_axis(logits, last[:, None, None],
+                                      axis=1)[:, 0, :]
+    sampled = sampling_ops.sample_dp(
+        last_logits, tpu_cfg.on_device_sampling_config, sampling_params,
+        rng).reshape(b)
+    verify_toks = jnp.where(idx <= n_acc[:, None], greedy, 0)
+    single_toks = jnp.where(idx == 0, sampled[:, None],
+                            jnp.zeros((), greedy.dtype))
+    tokens = jnp.where((emit_modes == 2)[:, None], verify_toks,
+                       jnp.where((emit_modes == 1)[:, None], single_toks,
+                                 jnp.zeros((), greedy.dtype)))
+    n_emit = jnp.where(emit_modes == 2, n_acc + 1,
+                       jnp.where(emit_modes == 1, 1, 0)).astype(jnp.int32)
+    out = {"tokens": tokens, "num_emitted": n_emit, "cache": new_cache}
+    if want_hidden:
+        out["hidden"] = hidden
+    return out
+
+
 def replace_output_logits(cfg: TpuConfig) -> TpuConfig:
     """decode_loop never returns per-step logits. Called at trace time only,
     so a plain copy per call is fine."""

@@ -84,31 +84,57 @@ def batch_buckets(tpu_config) -> List[int]:
     return generate_buckets(1, full)
 
 
+def ragged_row_buckets(ctx_buckets: List[int],
+                       chunk_tokens: Optional[int] = None) -> List[int]:
+    """THE unified per-row width ladder of the ragged mixed dispatch
+    (serving/ragged/, README "Ragged dispatch"): one ladder covers every
+    row shape a ``paged_ragged_step`` dispatch can carry — decode steps
+    (width 1), speculative verify windows (width k+1) and prefill chunks
+    (width up to the chunk cap) — so mixed load warms ONE set of shapes
+    instead of the three separate ctx / prefill-chunk / spec-width
+    ladders it used to pay.
+
+    The ladder is the powers-of-2 ramp from 1 up to the smallest ctx
+    bucket, merged with the ctx buckets themselves (so chunk dispatches
+    keep running at already-compiled ctx-bucket widths), capped at the
+    smallest ctx bucket covering ``chunk_tokens`` (``None`` = the full
+    ctx ladder)."""
+    if not ctx_buckets:
+        raise ValueError("ragged_row_buckets needs a non-empty ctx ladder")
+    if chunk_tokens is None:
+        cap = ctx_buckets[-1]
+    else:
+        cap = get_target_bucket(ctx_buckets,
+                                min(chunk_tokens, ctx_buckets[-1]))
+    low = generate_buckets(1, ctx_buckets[0])
+    return sorted({b for b in low if b <= cap}
+                  | {b for b in ctx_buckets if b <= cap})
+
+
 def prefill_chunk_buckets(ctx_buckets: List[int],
                           chunk_tokens: Optional[int] = None) -> List[int]:
-    """Width ladder for packed prefill-chunk dispatches (serving.py
-    ``PagedEngineAdapter``): the ctx buckets up to (and including) the
-    smallest bucket covering ``chunk_tokens`` — chunk dispatches then only
-    ever run at already-compiled ctx-bucket widths, never a fresh shape.
-    ``None`` keeps the full ladder (chunk = largest ctx bucket, the
-    monolithic-equivalent default)."""
-    if chunk_tokens is None:
-        return list(ctx_buckets)
-    cap = get_target_bucket(ctx_buckets, min(chunk_tokens, ctx_buckets[-1]))
-    return [b for b in ctx_buckets if b <= cap]
+    """DEPRECATED — thin wrapper over :func:`ragged_row_buckets`, kept so
+    external callers and existing tests keep working: the old standalone
+    prefill-chunk width ladder is the ctx-bucket slice of the unified
+    ragged ladder (chunk dispatches only ever ran at already-compiled
+    ctx-bucket widths). New code should consume ``ragged_row_buckets``
+    directly — the ragged dispatch pads prefill rows, decode rows and
+    verify windows to the SAME ladder."""
+    ctx = set(ctx_buckets)
+    return [b for b in ragged_row_buckets(ctx_buckets, chunk_tokens)
+            if b in ctx]
 
 
 def spec_width_buckets(max_width: int) -> List[int]:
-    """Verify-width ladder for speculative serving dispatches
-    (serving/speculation/): per-row candidate widths (accepted-token root
-    + drafts, clamped by seq_len headroom and token budgets) pad to the
-    smallest bucket, so the k+1-wide verify graph and its matching draft
-    loop only ever compile a bounded set of shapes. ``max_width`` =
-    speculation k + 1; the ladder always starts at 1 (a fully clamped
-    batch degenerates to an eager decode step through the same graph)."""
+    """DEPRECATED — thin wrapper over :func:`ragged_row_buckets`, kept so
+    external callers and existing tests keep working: the old standalone
+    verify-width ladder is the unified ragged ladder of a one-bucket
+    "ctx" ladder at ``max_width`` (= speculation k + 1; always starts at
+    1, so a fully clamped batch degenerates to an eager-width verify).
+    New code should consume ``ragged_row_buckets`` directly."""
     if max_width < 1:
         raise ValueError(f"spec width must be >= 1, got {max_width}")
-    return generate_buckets(1, max_width)
+    return ragged_row_buckets([max_width])
 
 
 def block_table_buckets(tpu_config, max_blocks: int) -> List[int]:

@@ -42,6 +42,13 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      its KV, so mid-verify failure must roll EVERY packed
                      row back to its last accepted token (no
                      half-accepted cache poisoning)
+  ``ragged_step``    THE unified mixed dispatch of a ragged engine step
+                     (serving/ragged/) — fires AFTER per-row KV growth
+                     and the draft pass, so a failure must roll EVERY
+                     packed row back to its last accepted/delivered
+                     token: live rows' growth shrunk with positions
+                     untouched, prefill rows aborted exactly like a
+                     failed chunk dispatch
   ``kv_spill``       a block payload spill into the host-RAM KV tier
                      (serving/fleet/kv_tier.py) — spills are best-effort:
                      a trip is swallowed by the adapter's spill hook and
@@ -73,7 +80,7 @@ __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 
 FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
                 "decode_step", "slow_step", "pipeline_flush",
-                "spec_draft", "spec_verify",
+                "spec_draft", "spec_verify", "ragged_step",
                 "kv_spill", "kv_restore", "handoff")
 
 

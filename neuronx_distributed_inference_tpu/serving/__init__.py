@@ -6,9 +6,12 @@ door; see README "Serving engine").
 
 Importing ``neuronx_distributed_inference_tpu.serving`` keeps exposing the
 adapter surface unchanged (this module used to be ``serving.py``); the
-engine layer is imported explicitly from ``.engine``, and the fleet layer
+engine layer is imported explicitly from ``.engine``, the fleet layer
 above it (replicated-engine router, host-RAM KV spill tier, disaggregated
-prefill handoff — README "Fleet") explicitly from ``.fleet``.
+prefill handoff — README "Fleet") explicitly from ``.fleet``, and the
+ragged unified dispatch (one mixed prefill+decode+verify dispatch per
+engine step, enabled with ``PagedEngineAdapter(app, ragged=True)`` —
+README "Ragged dispatch") explicitly from ``.ragged``.
 """
 
 from .adapter import (ContinuousBatchingAdapter, PagedEngineAdapter,

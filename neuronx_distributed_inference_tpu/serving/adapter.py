@@ -78,6 +78,20 @@ Chunked, packed, schedulable prefill — paged adapter only (see README
     pending sequence (its ``Preempted.tokens`` is the bare prompt,
     ``n_generated == 0``).
 
+Ragged unified dispatch — paged adapter only (see README "Ragged
+dispatch"; serving/ragged/):
+
+  * ``ragged=True`` routes EVERY engine step — decode rows, speculative
+    verify windows, pending prefill chunks — through ONE
+    ``model_base.paged_ragged_step`` dispatch planned by the
+    ``RaggedBatchPlanner``, padded within the unified
+    ``autobucketing.ragged_row_buckets`` ladder. Admission always defers
+    (``add_requests`` returns ``{}``) and ``prefill_budget_tokens``
+    becomes a per-step cap on packed prompt tokens instead of a
+    serialization point. Token streams stay bit-identical to the
+    two-phase path, with and without ``speculation=`` (pinned by
+    tests/test_ragged_dispatch.py).
+
 Resilience contract (see README "Serving resilience"):
 
   * every boundary failure is typed (``resilience.errors``) — never a bare
@@ -306,6 +320,23 @@ class _AdapterTelemetry:
         tmetrics.spec_verify_width_histogram(reg).observe(
             width, engine=self.engine)
         self._rows(reg, "decode", len(rows), padded)
+
+    def on_ragged_step(self, kind_rows: Dict[str, int], real_tokens: int,
+                       padded_tokens: int):
+        """One ragged unified dispatch: ``kind_rows`` maps row kind
+        (decode/prefill/verify/pad) to rows packed; the pad-waste gauge
+        tracks the last dispatch's (padded - real) / padded over the
+        unified row-bucket grid."""
+        reg = self.registry
+        if not reg.enabled:
+            return
+        counter = tmetrics.ragged_rows_counter(reg)
+        for kind, n in kind_rows.items():
+            if n:
+                counter.inc(n, engine=self.engine, kind=kind)
+        if padded_tokens:
+            tmetrics.ragged_pad_waste_gauge(reg).set(
+                1.0 - real_tokens / padded_tokens, engine=self.engine)
 
     def on_dispatch(self, depth: int):
         reg = self.registry
@@ -617,6 +648,7 @@ class _EngineAdapterBase:
         self._ready: Dict[int, int] = {}
         self._scratch = None
         self._spec = None              # SpeculativeDecodePath (paged only)
+        self._ragged = None            # RaggedDispatchPath (paged only)
         # plain-int host counters (always on — they feed the CPU
         # microbenches, bench.py --host-overhead / --prefill-overhead).
         # The decode counters (dispatches/blocking_fetches/...) count ONLY
@@ -1213,7 +1245,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
                  pipeline_depth: int = 0,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefill_budget_tokens: Optional[int] = None,
-                 speculation=None, kv_spill_tier=None):
+                 speculation=None, kv_spill_tier=None,
+                 ragged: bool = False):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -1257,9 +1290,18 @@ class PagedEngineAdapter(_EngineAdapterBase):
             app.kv_mgr.set_spill_hook(self._spill_block)
         if speculation is not None:
             # deferred import: speculation/ imports this module
-            from .speculation import SelfDraftProposer, SpeculativeDecodePath
+            from .speculation import SelfDraftProposer
             if isinstance(speculation, int):
                 speculation = SelfDraftProposer(speculation)
+        if ragged:
+            # ragged unified dispatch (serving/ragged/, README "Ragged
+            # dispatch"): ONE mixed prefill+decode+verify dispatch per
+            # engine step; subsumes the prefill-budget serialization
+            # point and composes with speculation=
+            from .ragged import RaggedDispatchPath
+            self._ragged = RaggedDispatchPath(self, speculation)
+        elif speculation is not None:
+            from .speculation import SpeculativeDecodePath
             self._spec = SpeculativeDecodePath(self, speculation)
 
     def add_requests(self, seq_ids: Sequence[int],
@@ -1360,8 +1402,11 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 "paged admission failed; all allocations from this call "
                 "were rolled back", phase="prefill",
                 seq_ids=seq_ids, retry_safe=True)) from e
-        if self.prefill_budget_tokens is not None:
-            return {}          # deferred: step() drives the chunks
+        if self.prefill_budget_tokens is not None or self._ragged is not None:
+            # deferred: step() drives the chunks (ragged mode always
+            # defers — the unified dispatch packs chunk rows WITH decode
+            # rows, so admission never serializes its own device work)
+            return {}
         cache_before = app.cache
         try:
             if _FAULTS.active:
@@ -1393,8 +1438,9 @@ class PagedEngineAdapter(_EngineAdapterBase):
     def release(self, seq_ids: Sequence[int]):
         if self._inflight is not None:
             self._stash_flush()
-        if self._spec is not None:
-            self._spec.proposer.forget(seq_ids)
+        proposer = self._active_proposer
+        if proposer is not None:
+            proposer.forget(seq_ids)
         for sid in seq_ids:
             self._ready.pop(sid, None)
             if sid in self._chunks:
@@ -1416,24 +1462,30 @@ class PagedEngineAdapter(_EngineAdapterBase):
         (see the base class). With ``speculation=`` attached the step is
         draft-and-verify and returns {seq_id: [tokens]} with 1..k+1
         tokens per row; ``token_room`` (scheduler hook) caps each row's
-        tokens-delivered for this step."""
+        tokens-delivered for this step. With ``ragged=True`` every step —
+        speculative or not — is ONE unified mixed dispatch through
+        serving/ragged/ and returns {seq_id: [tokens]}."""
+        if self._ragged is not None:
+            return self._ragged.step(seq_ids, token_room)
         if self._spec is not None:
             return self._spec.step(seq_ids, token_room)
         if token_room is not None:
             raise ConfigurationError(
                 "token_room is a speculative-decode hook; build the "
-                "adapter with speculation= to use it")
+                "adapter with speculation= or ragged=True to use it")
         return super().step(seq_ids)
 
     def step_many(self, num_steps: int,
                   seq_ids: Optional[Sequence[int]] = None
                   ) -> Dict[int, List[int]]:
         """Fused multi-step decode (base class). With ``speculation=``
-        attached, ``num_steps`` becomes a per-row TOKEN budget: the path
-        runs speculative steps — each one verify dispatch — until every
-        row has delivered its budget (rows with high accept rates finish
-        in fewer dispatches; no row ever overshoots)."""
-        if self._spec is None:
+        (or ``ragged=True``) attached, ``num_steps`` becomes a per-row
+        TOKEN budget: the path runs unified engine steps — each one
+        materialized dispatch — until every row has delivered its budget
+        (rows with high accept rates finish in fewer dispatches; no row
+        ever overshoots)."""
+        path = self._ragged if self._ragged is not None else self._spec
+        if path is None:
             return super().step_many(num_steps, seq_ids)
         if num_steps < 1:
             raise ConfigurationError("step_many requires num_steps >= 1")
@@ -1450,7 +1502,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
             if not ids and not self._pending_ids():
                 break
             room = {s: remaining.get(s, num_steps) for s in ids}
-            res = self._spec.step(ids, token_room=room)
+            res = path.step(ids, token_room=room)
             if not res and not ids:
                 break                  # pending-only pass made no tokens
             for s, toks in res.items():
@@ -1459,6 +1511,18 @@ class PagedEngineAdapter(_EngineAdapterBase):
         return out
 
     # -- decode dispatch ---------------------------------------------------
+    @property
+    def _active_proposer(self):
+        """The draft proposer of whichever decode path is engaged (the
+        standalone speculative path OR the ragged unified path), None
+        without speculation — release/preemption must drop per-sequence
+        proposer state through exactly one of them."""
+        if self._spec is not None:
+            return self._spec.proposer
+        if self._ragged is not None:
+            return self._ragged.proposer
+        return None
+
     def _append_token(self, st: _SeqState, tok: int):
         st.last_token = tok
         st.tokens.append(tok)
@@ -1589,6 +1653,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
                        "in_use": usable - free,
                        "unwritten": len(self._unwritten)},
             "preempted_uncollected": [int(r.seq_id) for r in self.preempted],
+            "ragged": self._ragged is not None,
         })
         return state
 
@@ -1750,10 +1815,11 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def _preempt(self, victim: int, reason: str):
         self._ready.pop(victim, None)      # replay regenerates it
-        if self._spec is not None:
+        proposer = self._active_proposer
+        if proposer is not None:
             # stateful proposers (Medusa/EAGLE) must not carry the
             # victim's features into a re-admission under the same id
-            self._spec.proposer.forget((victim,))
+            proposer.forget((victim,))
         cst = self._chunks.pop(victim, None)
         if cst is not None:
             # half-prefilled victim: blocks not fully written must leave

@@ -449,11 +449,15 @@ class ServingEngine:
         alive = self.adapter.seqs
         eligible: List[int] = []
         horizon = self.decode_steps_per_pass
-        # speculative adapter: the pass budgets by TOKENS-DELIVERED, not
-        # steps — each row gets its remaining token budget as a per-row
-        # candidate-width clamp (decode_steps_per_pass > 1 caps it), and
-        # the pass stays one engine step = one verify dispatch
+        # speculative / ragged adapter: the pass budgets by TOKENS-
+        # DELIVERED, not steps — each row gets its remaining token budget
+        # as a per-row candidate-width clamp (decode_steps_per_pass > 1
+        # caps it), and the pass stays one engine step. A ragged adapter
+        # routes through the RaggedBatchPlanner: ONE materialized mixed
+        # prefill+decode+verify dispatch per pass (serving/ragged/)
         spec = getattr(self.adapter, "_spec", None)
+        if spec is None:
+            spec = getattr(self.adapter, "_ragged", None)
         room: Dict[int, int] = {}
         for sid, req in self._active.items():
             if sid not in alive and sid not in pending:

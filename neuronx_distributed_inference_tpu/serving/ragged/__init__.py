@@ -1,0 +1,32 @@
+"""Ragged unified dispatch: ONE mixed prefill+decode+verify dispatch per
+engine step (ROADMAP item 1; README "Ragged dispatch"; reference shape:
+"Ragged Paged Attention", arxiv 2604.15464).
+
+The two-phase schedule this subsystem replaces ran each engine step as
+"at most one packed prefill-chunk dispatch, then one decode/verify
+dispatch", serialized by the ``prefill_budget_tokens`` point and warmed
+over three separate bucket ladders (ctx, prefill-chunk, spec-width).
+Ragged mode collapses that: a :class:`~.planner.RaggedBatchPlanner`
+assembles ALL runnable work — pending prefill chunks, live decode rows,
+speculative verify windows — into one row plan (per-row token offsets,
+widths, block tables and kind tags), and a
+:class:`~.path.RaggedDispatchPath` executes it as ONE
+``model_base.paged_ragged_step`` dispatch over the existing
+slot-mapping/block-table graph, padded within the unified
+``autobucketing.ragged_row_buckets`` ladder.
+
+Enable with ``PagedEngineAdapter(app, ragged=True)`` (composes with
+``speculation=``); ``ServingEngine.run_pass`` routes through the planner
+automatically. Every existing contract rides along: transactional
+rollback (``ragged_step`` fault point), chunked-prefill ``_unwritten``
+block confirmation, preemption/replay, deadlines, token budgets, and the
+speculation accept-rate pins — see the path module docstring and
+tests/test_ragged_dispatch.py for the pinned guarantees.
+"""
+
+from .planner import (KIND_DECODE, KIND_PREFILL, KIND_VERIFY,
+                      RaggedBatchPlanner, RaggedPlan, RaggedRow)
+from .path import RaggedDispatchPath
+
+__all__ = ["RaggedBatchPlanner", "RaggedDispatchPath", "RaggedPlan",
+           "RaggedRow", "KIND_DECODE", "KIND_PREFILL", "KIND_VERIFY"]

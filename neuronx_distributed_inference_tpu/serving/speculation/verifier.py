@@ -190,9 +190,16 @@ class SpeculativeDecodePath:
         for s in live:
             self.adapter.app.kv_mgr.shrink(s, widths[s])
 
-    def _draft_verify_accept(self, live: List[int], widths: Dict[int, int],
-                             t0: float) -> Dict[int, List[int]]:
-        import jax.numpy as jnp
+    def run_draft(self, live: List[int], widths: Dict[int, int],
+                  rollback) -> Tuple[Any, int, _SpecContext]:
+        """The draft preamble shared by the standalone speculative step
+        and the ragged unified step: build the row-0-padded
+        :class:`_SpecContext` over ``live``, fire the ``spec_draft``
+        fault point, and run the proposer's draft pass. On any failure
+        ``rollback()`` unwinds the caller's KV growth before the typed
+        raise. Returns ``(drafts or None, bucketed width W, ctx)`` —
+        a sat-out proposer (``drafts is None`` with ``W > 1``) leaves
+        the unused-window release to the caller."""
         ad = self.adapter
         app = ad.app
         b = len(live)
@@ -213,23 +220,35 @@ class SpeculativeDecodePath:
                            first=first, positions=pos, widths=wid,
                            block_table=bt)
         cache_before = app.cache
-        tenant = ad._tenant_of(live)
         try:
             if _FAULTS.active:
                 _FAULTS.fire("spec_draft")
             drafts = (self.proposer.propose(ctx) if W > 1 else None)
         except ServingError as e:
-            self._rollback(live, widths)
+            rollback()
             _trace_error(e)
             raise
         except Exception as e:
-            self._rollback(live, widths)
-            ad.telemetry.on_step_failure("spec", tenant)
+            rollback()
+            ad.telemetry.on_step_failure("spec", ad._tenant_of(live))
             raise _trace_error(StepFailure(
                 "speculative draft pass failed; KV growth was rolled back "
                 "and positions were not advanced",
                 phase="spec_draft", seq_ids=tuple(live),
                 retry_safe=app.cache is cache_before)) from e
+        return drafts, W, ctx
+
+    def _draft_verify_accept(self, live: List[int], widths: Dict[int, int],
+                             t0: float) -> Dict[int, List[int]]:
+        import jax.numpy as jnp
+        ad = self.adapter
+        app = ad.app
+        tenant = ad._tenant_of(live)
+        drafts, W, ctx = self.run_draft(
+            live, widths, lambda: self._rollback(live, widths))
+        b, pad_to = ctx.b, ctx.padded_batch
+        first, pos, wid, bt = (ctx.first, ctx.positions, ctx.widths,
+                               ctx.block_table)
         if drafts is None and W > 1:
             # the proposer sat this step out: release the unused window
             for s in live:

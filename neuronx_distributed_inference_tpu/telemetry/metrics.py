@@ -73,6 +73,9 @@ KV_BLOCK_ALLOC_FAILURES_TOTAL = "nxdi_kv_block_alloc_failures_total"
 PREFIX_CACHE_HIT_TOKENS_TOTAL = "nxdi_prefix_cache_hit_tokens_total"
 
 # -- speculative serving (serving/speculation/) ------------------------------
+RAGGED_ROWS_TOTAL = "nxdi_ragged_rows_total"     # engine, kind
+RAGGED_PAD_WASTE = "nxdi_ragged_pad_waste"       # engine
+
 SPEC_DRAFTED_TOKENS_TOTAL = "nxdi_spec_drafted_tokens_total"     # engine
 SPEC_ACCEPTED_TOKENS_TOTAL = "nxdi_spec_accepted_tokens_total"   # engine
 SPEC_ACCEPT_RATE = "nxdi_spec_accept_rate"                       # engine
@@ -351,6 +354,23 @@ def kv_alloc_failures_counter(reg):
 def prefix_hit_tokens_counter(reg):
     return reg.counter(PREFIX_CACHE_HIT_TOKENS_TOTAL,
                        "Prompt tokens served from the prefix cache")
+
+
+def ragged_rows_counter(reg):
+    return reg.counter(
+        RAGGED_ROWS_TOTAL,
+        "Rows packed into ragged unified dispatches, by kind: decode "
+        "steps, prefill chunks, speculative verify windows and batch-pad "
+        "rows (serving/ragged/)",
+        labels=("engine", "kind"))
+
+
+def ragged_pad_waste_gauge(reg):
+    return reg.gauge(
+        RAGGED_PAD_WASTE,
+        "Padded-token waste fraction of the last ragged unified dispatch "
+        "((padded - real) / padded over the rows x unified-width grid)",
+        labels=("engine",))
 
 
 def spec_drafted_counter(reg):

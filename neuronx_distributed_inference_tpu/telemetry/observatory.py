@@ -472,6 +472,19 @@ def _graph_entries(app) -> List[Tuple[str, str, Callable[[], Tuple]]]:
                       np.full((b, sw), -1, np.int32),
                       np.zeros((b, width_bt), np.int32),
                       np.ones((b,), np.int32)), {})))
+        # the ragged UNIFIED dispatch (serving/ragged/): one mixed
+        # prefill+decode+verify graph at the same representative width
+        entries.append((
+            "ragged", f"W{sw}xb{b}",
+            lambda: (app._jit_ragged(False),
+                     (app.params, app.cache, np.zeros((b, sw), np.int32),
+                      np.zeros((b, sw), np.int32),
+                      np.full((b, sw), -1, np.int32),
+                      np.zeros((b, width_bt), np.int32),
+                      np.ones((b,), np.int32),
+                      np.zeros((b,), np.int32),
+                      app._default_sampling_params(b),
+                      rng), {})))
         return entries
 
     cb = cfg.ctx_batch_size

@@ -37,6 +37,9 @@ plumbing; all CPU-mesh compiles, no execution):
   * ``paged_spec_verify_dp2tp2`` — the speculative ragged k+1-wide
     verify dispatch (serving/speculation/) at the default self-draft
     ladder top (W=4)
+  * ``paged_ragged_dp2tp2`` — the ragged UNIFIED mixed
+    prefill+decode+verify dispatch (serving/ragged/,
+    ``model_base.paged_ragged_step``) at the same W=4
 
 Usage::
 
@@ -241,6 +244,7 @@ PINNED: Dict[str, Any] = {
     "paged_loop_dp2tp2": lambda: _app_graph(True, "paged_loop"),
     "cb_decode_dp2tp2": lambda: _app_graph(False, "decode"),
     "paged_spec_verify_dp2tp2": lambda: _app_graph(True, "spec_verify"),
+    "paged_ragged_dp2tp2": lambda: _app_graph(True, "ragged"),
 }
 
 

@@ -392,6 +392,42 @@ def test_medusa_eagle_proposers_padded_batch(app4):
     assert eng._spec.proposer._slots == {}   # slots recycled on release
 
 
+def test_medusa_rides_ragged_unified_dispatch(app4):
+    """MedusaProposer composes with ``ragged=True`` (serving/ragged/):
+    the wants_hidden feature plumbing feeds from the UNIFIED dispatch's
+    hidden output (ctx rows re-padded as row-0 clones even while a
+    STAGGERED admission's prefill chunk shares the grid), streams stay
+    bit-identical to eager decode, and every engine step is exactly one
+    materialized dispatch."""
+    prompts = [RNG.integers(1, 500, size=n).tolist() for n in (6, 9, 7)]
+    want = 8
+    refs = _ref_streams(app4, prompts, want - 1)
+    eng = PagedEngineAdapter(app4, ragged=True,
+                             speculation=MedusaProposer(2))
+    assert eng.add_requests([0, 1], prompts[:2]) == {}
+    got = {s: [] for s in (0, 1, 2)}
+    steps = 0
+    while any(len(got[s]) < 3 for s in (0, 1)):
+        for s, toks in eng.step().items():
+            got[s].extend(toks)
+        steps += 1
+        assert steps < 60, "ragged medusa made no progress"
+    # mid-decode admission: its chunk packs WITH the live verify rows
+    assert eng.add_requests([2], [prompts[2]]) == {}
+    while any(len(got[s]) < want for s in got):
+        before = eng.host_stats["blocking_fetches"]
+        for s, toks in eng.step().items():
+            got[s].extend(toks)
+        assert eng.host_stats["blocking_fetches"] - before == 1
+        steps += 1
+        assert steps < 60, "ragged medusa made no progress"
+    assert eng._ragged.proposer._feat        # features seeded per row
+    eng.release([0, 1, 2])
+    for s in (0, 1, 2):
+        assert got[s][:want] == refs[s][:want]
+    assert eng._ragged.proposer._feat == {}  # forget on release
+
+
 def test_on_verify_failure_degrades_not_corrupts(app):
     """A proposer crashing in post-verify feedback must only cost
     acceptance state, never the stream: the step's tokens are still
