@@ -693,6 +693,88 @@ def fleet_load_main(artifact_path="artifacts/bench_fleet_r11.json"):
     _emit_report_artifact(payload, artifact_path, "fleet-load")
 
 
+def slo_report_main(artifact_path="artifacts/bench_slo_r14.json"):
+    """CPU-runnable SLO-plane report (ISSUE 14): a two-tenant
+    closed-loop run on the tiny synthetic paged engine with an
+    SLOTracker attached — per-tenant TTFT / TPOT / queue-wait p50/p99
+    over the rolling windows, attainment and burn rate against a
+    deliberately tight policy (so the burn math exercises non-zero
+    violations on any host), and the advisory degradation hint. One
+    parseable JSON line + an artifact file; no TPU required. This is
+    the answer layer over the histograms the engine already records:
+    the numbers the Gemma-on-Cloud-TPU serving comparison (PAPERS.md,
+    arxiv 2605.25645) frames as the serving yardstick."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
+    from neuronx_distributed_inference_tpu.telemetry.slo import (SLOPolicy,
+                                                                 SLOTracker)
+
+    hf = _tiny_llama_hf()
+    tcfg = TpuConfig(batch_size=4, seq_len=128, dtype="float32",
+                     enable_bucketing=True,
+                     context_encoding_buckets=[16, 64],
+                     is_block_kv_layout=True, pa_block_size=16,
+                     is_prefix_caching=True)
+    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                   LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    # tight targets: on a CPU host the decode step is slower than 2 ms,
+    # so tpot burns by construction — the report demonstrates real burn
+    # math, not a wall of zeros (ttft/queue_wait stay generous)
+    policy = SLOPolicy(targets={"ttft": 2.0, "tpot": 0.002,
+                                "queue_wait": 2.0}, objective=0.9)
+    tracker = SLOTracker(policy)
+    eng = ServingEngine(PagedEngineAdapter(app), starvation_bound_s=30.0,
+                        tenant_weights={"gold": 2.0, "bronze": 1.0},
+                        slo=tracker)
+    rng = np.random.default_rng(0)
+    max_new = 8
+    streams = []
+    t_start = time.perf_counter()
+    for wave in range(2):
+        # 2x oversubscription per wave so queue wait is non-zero
+        for i in range(8):
+            tenant = "gold" if i % 2 == 0 else "bronze"
+            streams.append(eng.submit(
+                rng.integers(1, 500, size=12).tolist(), max_new,
+                tenant=tenant))
+        eng.run_until_drained()
+    wall = time.perf_counter() - t_start
+    assert all(s.finish_reason == "length" for s in streams)
+
+    report = tracker.report()
+    hint = report["hint"]
+    burns = [sig.get("burn_rate", {}).get("long", 0.0)
+             for ten in report["tenants"].values() for sig in ten.values()]
+    payload = {
+        "metric": "slo_report_max_burn_rate_long",
+        "value": round(max(burns), 4) if burns else 0.0,
+        "unit": "violation_fraction_over_error_budget",
+        "details": {
+            "schema": report["schema"],
+            "requests": len(streams),
+            "tenants": report["tenants"],
+            "policy": report["policy"],
+            "degradation_hint": hint,
+            "wall_s": round(wall, 2),
+            "max_new_tokens": max_new,
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "slo-report")
+
+
 def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
     """CPU-runnable compiled-graph observatory report (ISSUE 7): AOT
     ``.lower().compile()`` of every bucket-ladder graph of the tiny
@@ -870,6 +952,7 @@ def _no_tpu_fallback(error: str):
                      ("ragged_overhead", ragged_overhead_main),
                      ("serving_load", serving_load_main),
                      ("fleet_load", fleet_load_main),
+                     ("slo_report", slo_report_main),
                      ("graph_report", graph_report_main),
                      ("lint_report", lint_report_main)):
         try:
@@ -922,6 +1005,8 @@ def main():
         return serving_load_main()
     if "--fleet-load" in sys.argv[1:]:
         return fleet_load_main()
+    if "--slo-report" in sys.argv[1:]:
+        return slo_report_main()
     if "--graph-report" in sys.argv[1:]:
         return graph_report_main()
     if "--sharding-report" in sys.argv[1:]:

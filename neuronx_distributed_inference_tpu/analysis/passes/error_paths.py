@@ -33,6 +33,7 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/fleet/router.py",
     "neuronx_distributed_inference_tpu/serving/fleet/kv_tier.py",
     "neuronx_distributed_inference_tpu/serving/fleet/handoff.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/aggregator.py",
     "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
 )
 

@@ -6,13 +6,21 @@ Port of the PR-7 ``scripts/check_metric_names.py`` checker: every
 appear in the README "Observability" metric table, and every ``nxdi_*``
 name in that table must be a registered constant — symmetric, like the
 SPMD golden.
+
+Extended (ISSUE 14) with the **helper contract**: every builder helper
+in ``telemetry/metrics.py`` (a module-level function taking ``reg`` and
+returning ``reg.counter/gauge/histogram(...)``) must name its instrument
+through an ``nxdi_``-prefixed module constant (or literal) and pass
+non-empty help text — so an instrument can never be registered under an
+undocumentable name or with a blank description (rename-red verified by
+``tests/test_slo_observability.py``).
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set
 
 from ..findings import Finding
 from ..registry import LintContext, Pass, register
@@ -26,15 +34,100 @@ _NAME_RE = re.compile(r"nxdi_[a-z0-9_]+")
 def registered_names(tree: ast.AST) -> Set[str]:
     """``nxdi_*`` string constants assigned at module level in
     telemetry/metrics.py — the canonical registration point."""
-    names: Set[str] = set()
+    return set(constant_map(tree).values())
+
+
+def constant_map(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``CONSTANT = "nxdi_..."`` assignments, constant name
+    -> metric name (the helper contract resolves ``reg.counter(NAME)``
+    references through this)."""
+    out: Dict[str, str] = {}
     for node in tree.body:
         if not isinstance(node, (ast.Assign, ast.AnnAssign)):
             continue
         value = node.value
-        if (isinstance(value, ast.Constant) and isinstance(value.value, str)
+        if not (isinstance(value, ast.Constant)
+                and isinstance(value.value, str)
                 and value.value.startswith("nxdi_")):
-            names.add(value.value)
-    return names
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = value.value
+    return out
+
+
+_INSTRUMENT_KINDS = ("counter", "gauge", "histogram")
+
+
+def helper_findings(pass_name: str, rel: str, tree: ast.AST,
+                    constants: Dict[str, str]) -> List[Finding]:
+    """The helper contract over telemetry/metrics.py: every module-level
+    function whose first parameter is ``reg`` must build its instrument
+    via ``reg.counter/gauge/histogram(<nxdi_ constant>, <non-empty
+    help>, ...)`` — a helper with no instrument call, an unresolvable or
+    un-prefixed name, or blank/missing help text is a finding."""
+    findings: List[Finding] = []
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        args = node.args.args
+        if not args or args[0].arg != "reg":
+            continue
+        calls = [c for c in ast.walk(node)
+                 if isinstance(c, ast.Call)
+                 and isinstance(c.func, ast.Attribute)
+                 and c.func.attr in _INSTRUMENT_KINDS
+                 and isinstance(c.func.value, ast.Name)
+                 and c.func.value.id == "reg"]
+        if not calls:
+            findings.append(Finding(
+                pass_name, rel, node.lineno,
+                f"helper {node.name}() takes `reg` but never builds an "
+                "instrument (reg.counter/gauge/histogram) — dead helper "
+                "or a bypass of the canonical registration point"))
+            continue
+        for call in calls:
+            findings.extend(_check_instrument_call(pass_name, rel,
+                                                   node.name, call,
+                                                   constants))
+    return findings
+
+
+def _check_instrument_call(pass_name: str, rel: str, fn: str,
+                           call: ast.Call,
+                           constants: Dict[str, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    name_arg = call.args[0] if call.args else None
+    metric_name = None
+    if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value,
+                                                         str):
+        metric_name = name_arg.value
+    elif isinstance(name_arg, ast.Name):
+        metric_name = constants.get(name_arg.id)
+    if metric_name is None:
+        findings.append(Finding(
+            pass_name, rel, call.lineno,
+            f"helper {fn}() builds an instrument whose name is not a "
+            "module-level nxdi_* constant (or literal) — the README "
+            "table lint cannot see it"))
+    elif not metric_name.startswith("nxdi_"):
+        findings.append(Finding(
+            pass_name, rel, call.lineno,
+            f"helper {fn}() registers {metric_name!r} — metric names "
+            "must carry the nxdi_ prefix (stable-contract namespace)"))
+    # help text: second positional arg or help= keyword
+    help_arg = call.args[1] if len(call.args) > 1 else next(
+        (kw.value for kw in call.keywords if kw.arg == "help"), None)
+    if not (isinstance(help_arg, ast.Constant)
+            and isinstance(help_arg.value, str)
+            and help_arg.value.strip()):
+        findings.append(Finding(
+            pass_name, rel, call.lineno,
+            f"helper {fn}() registers an instrument without non-empty "
+            "help text — every exposed metric must describe itself"))
+    return findings
 
 
 def documented_names(readme_source: str) -> Set[str]:
@@ -60,7 +153,9 @@ def documented_names(readme_source: str) -> Set[str]:
 class MetricNamesPass(Pass):
     name = "metric-names"
     description = ("telemetry nxdi_* name constants and the README "
-                   "Observability table stay in sync, both directions")
+                   "Observability table stay in sync, both directions; "
+                   "every metrics.py helper registers an nxdi_-named "
+                   "instrument with non-empty help")
     default_paths = (METRICS_PATH, README_PATH)
 
     def run(self, ctx: LintContext,
@@ -77,15 +172,22 @@ class MetricNamesPass(Pass):
         if metrics_sf.tree is None:
             return [Finding(self.name, metrics_sf.rel, 1,
                             "not parseable as Python — wrong file?")]
-        registered = registered_names(metrics_sf.tree)
+        constants = constant_map(metrics_sf.tree)
+        registered = set(constants.values())
         documented = documented_names(readme_sf.text)
+        findings.extend(helper_findings(self.name, metrics_sf.rel,
+                                        metrics_sf.tree, constants))
         if not registered:
-            return [Finding(self.name, metrics_sf.rel, 1,
-                            "no nxdi_* constants found — wrong file?")]
+            # keep any helper-contract findings already collected: a
+            # constants-free metrics file is exactly where helpers go
+            # rogue with literals, and those findings are the point
+            return findings + [Finding(
+                self.name, metrics_sf.rel, 1,
+                "no nxdi_* constants found — wrong file?")]
         if not documented:
-            return [Finding(self.name, readme_sf.rel, 1,
-                            "no Observability metric table found — "
-                            "wrong file?")]
+            return findings + [Finding(
+                self.name, readme_sf.rel, 1,
+                "no Observability metric table found — wrong file?")]
         for nm in sorted(registered - documented):
             findings.append(Finding(
                 self.name, readme_sf.rel, 1,

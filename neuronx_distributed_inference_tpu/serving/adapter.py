@@ -129,6 +129,7 @@ from ..resilience.preemption import (PREEMPTION_POLICIES, Preempted,
                                     pick_victim)
 from ..telemetry import get_registry
 from ..telemetry import metrics as tmetrics
+from ..telemetry.request_trace import trace_of as _trace_of
 from ..telemetry.trace import get_recorder as _get_recorder
 
 
@@ -1832,7 +1833,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 prompt_len=len(cst.prompt), n_generated=0, reason=reason,
                 deadline=cst.deadline, meta=cst.meta,
                 trace_id=self._trace_preempt(victim, reason, tenant,
-                                             pending=True)))
+                                             pending=True,
+                                             trace=_trace_of(cst.meta))))
             self.telemetry.on_preempt(victim, reason, tenant)
             return
         st = self.seqs.pop(victim)
@@ -1845,17 +1847,20 @@ class PagedEngineAdapter(_EngineAdapterBase):
             prompt_len=st.prompt_len,
             n_generated=len(st.tokens) - st.prompt_len, reason=reason,
             deadline=st.deadline, meta=st.meta,
-            trace_id=self._trace_preempt(victim, reason, tenant)))
+            trace_id=self._trace_preempt(victim, reason, tenant,
+                                         trace=_trace_of(st.meta))))
         self.telemetry.on_preempt(victim, reason, tenant)
 
     def _trace_preempt(self, victim: int, reason: str, tenant: str,
-                       pending: bool = False) -> Optional[str]:
+                       pending: bool = False,
+                       trace: Optional[str] = None) -> Optional[str]:
         rec = _get_recorder()
         if not rec.enabled:
             return None
         return rec.instant("preempt", cat="adapter",
                            engine=self.engine_name, seq_id=victim,
-                           reason=reason, tenant=tenant, pending=pending)
+                           reason=reason, tenant=tenant, pending=pending,
+                           trace=trace)
 
     def _grow_with_preemption(self, live: Sequence[int],
                               n: int = 1) -> List[int]:

@@ -22,8 +22,11 @@ engine's submit / stream / cancel / metrics surface:
   ``POST /v1/cancel/<id>``
       Returns ``{"cancelled": bool}``. Cancelling a queued request costs
       no device work; a running one is released and its blocks reclaimed.
-  ``GET /metrics``
-      Prometheus text exposition of the process-global registry.
+  ``GET /v1/metrics`` (alias ``GET /metrics``)
+      Prometheus text exposition: the process-global registry, or —
+      with ``fleet=`` and a router aggregator attached — the
+      fleet-merged exposition with a ``replica`` label per series. An
+      engine SLO tracker exports its gauges at scrape time.
   ``GET /healthz``
       ``{"ok": true, "queue_depth": n, "running": m}``.
   ``GET /v1/debug/state``
@@ -34,6 +37,11 @@ engine's submit / stream / cancel / metrics surface:
   ``GET /v1/debug/trace``
       The flight recorder as Chrome trace-event JSON — save the body and
       open it in ``chrome://tracing`` / Perfetto.
+  ``GET /v1/debug/trace/<id>``
+      ONE request's trace (``<id>`` = request id or trace id): the
+      events carrying its ``trace_id`` — queue, admission, dispatch
+      rows, requeues, emission — as Chrome trace JSON; 404 when nothing
+      matches (unknown id / recorder disabled).
 
 Client-gone behaviour: when an SSE write fails (peer reset / closed), the
 front end cancels the request through the engine — blocks are reclaimed
@@ -168,14 +176,25 @@ class ServingFrontend:
                 "ok": not self.engine._closed,
                 "queue_depth": self.engine.queue.depth,
                 "running": len(self.engine._active)})
-        elif path == "/metrics" and method == "GET":
-            text = get_registry().render_prometheus()
-            await self._send_raw(writer, 200, text.encode(),
+        elif path in ("/metrics", "/v1/metrics") and method == "GET":
+            # /v1/metrics is the served exposition surface (the bare
+            # /metrics alias predates it and stays for compatibility):
+            # Prometheus text of the process registry — or, with a fleet
+            # aggregator attached, the N replica registries merged under
+            # a `replica` label (serving/fleet/aggregator.py)
+            await self._send_raw(writer, 200, self._metrics_text().encode(),
                                  "text/plain; version=0.0.4")
         elif path == "/v1/debug/state" and method == "GET":
             # live post-mortem: engine/adapter snapshot + flight-recorder
             # tail (events empty while the recorder is disabled)
             await self._send_json(writer, 200, self._debug_payload())
+        elif path.startswith("/v1/debug/trace/") and method == "GET":
+            # per-request trace: <id> is a request id (resolved through
+            # the engine/router trace maps) or a raw trace id; returns
+            # Chrome trace-event JSON filtered to that one request
+            await self._send_json(
+                writer, 200,
+                self._trace_payload(path[len("/v1/debug/trace/"):]))
         elif path == "/v1/debug/trace" and method == "GET":
             # Chrome trace-event JSON — save the body and load it in
             # chrome://tracing or Perfetto
@@ -213,6 +232,66 @@ class ServingFrontend:
                              f"no route for {method} {path}")
 
     # -- engine glue -------------------------------------------------------
+    def _metrics_text(self) -> str:
+        """The ``GET /v1/metrics`` body. With a fleet router whose
+        ``aggregator`` is set (per-replica registries), the fleet-wide
+        merged exposition — each replica engine's SLO tracker exported
+        into ITS registry first; otherwise the process-global registry
+        with this engine's SLO gauges exported into it. Pull-model
+        either way: burn rates are computed when someone looks."""
+        agg = getattr(self.fleet, "aggregator", None) \
+            if self.fleet is not None else None
+        if agg is not None:
+            export = getattr(self.fleet, "export_slo", None)
+            if export is not None:
+                export()
+            if self.engine.slo is not None:
+                # this frontend's engine may itself be a replica: export
+                # its scrape-time SLO gauges into ITS registry (global
+                # otherwise, landing under the pseudo-replica below)
+                reg_of = getattr(self.fleet, "registry_of",
+                                 lambda _e: None)
+                self.engine.slo.export(reg_of(self.engine)
+                                       or get_registry())
+            # the router's OWN series (nxdi_fleet_*, handoffs) live in
+            # the process-global registry — merge it in as one more
+            # source so enabling fleet exposition never hides them. A
+            # series carrying its own `replica` label (the fleet
+            # counters) keeps it; everything else from the global
+            # registry — including direct HTTP traffic on this
+            # frontend's engine, which bypasses the router's registry
+            # scoping — is labeled with the pseudo-replica below.
+            from ..fleet.aggregator import FleetMetricsAggregator
+            sources = dict(agg.sources)
+            label = "router"
+            while label in sources:
+                label = "_" + label
+            sources[label] = get_registry()
+            return FleetMetricsAggregator(sources).render_prometheus()
+        if self.engine.slo is not None:
+            self.engine.slo.export(get_registry())
+        return get_registry().render_prometheus()
+
+    def _trace_payload(self, key: str) -> Dict[str, Any]:
+        """Chrome trace JSON of ONE request's events: ``key`` is a
+        request id known to the engine (or the attached fleet router) or
+        a literal trace id. 404 when no events match — an unknown id and
+        a disabled recorder look the same on purpose (neither has a
+        story to tell)."""
+        from ...telemetry.request_trace import trace_events
+        tid = self.engine.trace_id_of(key)
+        if tid is None and self.fleet is not None:
+            tid = getattr(self.fleet, "trace_id_of", lambda _k: None)(key)
+        tid = tid or key
+        rec = get_recorder()
+        events = trace_events(rec.events(), tid)
+        if not events:
+            raise _HttpError(404, f"no trace events for {key!r} (unknown "
+                                  "id, aged out, or recorder disabled)")
+        payload = rec.to_chrome(events)
+        payload["otherData"]["trace_id"] = tid
+        return payload
+
     def _debug_payload(self) -> Dict[str, Any]:
         """The ``GET /v1/debug/state`` body: the engine post-mortem dump
         plus — with a fleet router attached — the router's snapshot

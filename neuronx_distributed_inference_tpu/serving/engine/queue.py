@@ -70,6 +70,11 @@ class QueuedRequest:
     stop_tokens: frozenset = frozenset()
     n_preemptions: int = 0
     meta: dict = field(default_factory=dict)
+    # SLO-plane anchors (host wall clock; written only when the engine
+    # has an SLOTracker attached — see scheduler.py)
+    t_first: Optional[float] = None    # first token delivered
+    t_last: Optional[float] = None     # latest token delivered
+    last_enqueue_t: Optional[float] = None   # most recent (re)queue entry
 
     def sort_key(self) -> Tuple[int, int]:
         return (-self.priority, self.order)

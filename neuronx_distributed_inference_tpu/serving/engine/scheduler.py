@@ -49,6 +49,7 @@ from ...resilience.errors import (AdmissionError, CapacityError,
                                   ServingError, StepFailure)
 from ...telemetry import get_registry
 from ...telemetry import metrics as tmetrics
+from ...telemetry.request_trace import new_trace_id, trace_of
 from ...telemetry.trace import get_recorder as _get_recorder
 from .queue import MultiTenantQueue, QueuedRequest
 from .streams import TokenStream
@@ -69,7 +70,11 @@ class ServingEngine:
     ``max_unread_tokens`` bounds how far a stream may run ahead of its
     consumer before the engine stops stepping that sequence (None = no
     backpressure). ``priority_preemption=False`` disables scheduler-driven
-    eviction (the adapter's own KV-pressure preemption still applies)."""
+    eviction (the adapter's own KV-pressure preemption still applies).
+    ``slo`` attaches a :class:`~...telemetry.slo.SLOTracker`: the engine
+    feeds it TTFT (submit → first token), per-request mean TPOT and queue
+    wait per tenant, host-side only — its report/hint surface is
+    read-only (``debug_state()["slo"]``, ``bench.py --slo-report``)."""
 
     def __init__(self, adapter, *,
                  tenant_weights: Optional[Dict[str, float]] = None,
@@ -79,7 +84,8 @@ class ServingEngine:
                  max_unread_tokens: Optional[int] = None,
                  decode_steps_per_pass: int = 1,
                  priority_preemption: bool = True,
-                 debug_dump_dir: Optional[str] = None):
+                 debug_dump_dir: Optional[str] = None,
+                 slo=None):
         for hook in ("take_preempted", "preempt", "prefix_warmth",
                      "free_capacity", "pending_prefill_ids"):
             if not hasattr(adapter, hook):
@@ -98,8 +104,12 @@ class ServingEngine:
         # post-mortem artifacts: when set, an unrecoverable StepFailure
         # writes dump_debug_state() here before the engine closes
         self.debug_dump_dir = debug_dump_dir
+        # advisory per-tenant SLO plane (telemetry/slo.py); None = no
+        # tracking cost at all (every hook is one attribute check)
+        self.slo = slo
         self._active: Dict[int, QueuedRequest] = {}     # seq_id -> request
         self._sid_of: Dict[str, int] = {}               # request_id -> seq
+        self._trace_ids: Dict[str, str] = {}   # request_id -> trace (bounded)
         self._seq_ids = itertools.count()
         self._rid_counter = itertools.count()
         self._reserved: List[str] = []   # rids owed the next freed slots
@@ -119,12 +129,20 @@ class ServingEngine:
                tenant: str = "default", priority: int = 0,
                deadline_s: Optional[float] = None,
                stop_tokens: Sequence[int] = (),
-               request_id: Optional[str] = None) -> TokenStream:
+               request_id: Optional[str] = None,
+               trace_id: Optional[str] = None) -> TokenStream:
         """Enqueue one request; returns its :class:`TokenStream`
         immediately (no device work happens here). Raises the typed
         :class:`~...resilience.errors.QueueOverflow` when the queue is at
         ``max_queue_depth`` and :class:`AdmissionError` for malformed
-        arguments — both before any state change."""
+        arguments — both before any state change.
+
+        ``trace_id`` continues an existing request trace (a fleet router
+        or handoff continuation passes the original id); None mints a
+        fresh one. The id rides ``meta["trace"]`` through the adapter,
+        ``Preempted`` records and handoffs, so one trace follows the
+        request across preemptions and replicas (see
+        telemetry/request_trace.py)."""
         if self._closed:
             raise ServingError("engine is closed")
         tokens = [int(t) for t in tokens]
@@ -145,6 +163,7 @@ class ServingEngine:
                 r.request_id == rid for r in self._queued()):
             raise AdmissionError(f"request_id {rid!r} already in flight")
         now = time.perf_counter()
+        tid = trace_id if trace_id is not None else new_trace_id()
         stream = TokenStream(rid, tenant)
         req = QueuedRequest(
             request_id=rid, tokens=tokens, max_new_tokens=max_new_tokens,
@@ -154,10 +173,17 @@ class ServingEngine:
             orig_prompt_len=len(tokens),
             stop_tokens=frozenset(int(t) for t in stop_tokens),
             meta={"request_id": rid, "tenant": tenant,
-                  "priority": priority})
+                  "priority": priority, "trace": tid})
         self.queue.push(req)         # may raise QueueOverflow
         stream._cancel_cb = lambda: self.cancel(rid)
         self.stats["submitted"] += 1
+        self._remember_trace(rid, tid)
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("trace.begin", cat="request", trace=tid,
+                        request_id=rid, tenant=tenant,
+                        prompt_len=len(tokens), deadline_s=deadline_s,
+                        continued=trace_id is not None)
         return req.stream
 
     def cancel(self, request_id: str) -> bool:
@@ -169,6 +195,7 @@ class ServingEngine:
         if req is not None:
             self._observe_wait(req, "cancelled")
             req.stream.finish("cancelled", req.stream.cancelled_error())
+            self._finalize(req)
             self.stats["cancelled"] += 1
             return True
         sid = self._sid_of.get(request_id)
@@ -177,6 +204,7 @@ class ServingEngine:
         req = self._retire(sid)
         self.adapter.release([sid])
         req.stream.finish("cancelled", req.stream.cancelled_error())
+        self._finalize(req)
         self.stats["cancelled"] += 1
         return True
 
@@ -192,12 +220,22 @@ class ServingEngine:
         token budget (the caller already delivered the rest)."""
         kw = rec.admission_kwargs()
         meta = kw["meta"][0] if isinstance(kw["meta"][0], dict) else {}
-        return self.submit(
+        stream = self.submit(
             kw["prompts"][0], max_new_tokens,
             tenant=str(meta.get("tenant", "default")),
             priority=int(meta.get("priority", 0)),
             deadline_s=kw["deadline_s"][0], stop_tokens=stop_tokens,
-            request_id=request_id)
+            request_id=request_id, trace_id=trace_of(meta))
+        if self.slo is not None and rec.n_generated > 0:
+            # a continuation: the CLIENT saw its first token long ago on
+            # the failed replica — this engine's first delivery must not
+            # be observed as a fresh (artificially fast) TTFT sample
+            now = time.perf_counter()
+            for r in self._queued():
+                if r.request_id == stream.request_id:
+                    r.t_first = r.t_last = now
+                    break
+        return stream
 
     @property
     def closed(self) -> bool:
@@ -273,10 +311,12 @@ class ServingEngine:
         for req in list(self._queued()):
             self.queue.remove(req.request_id)
             req.stream.finish("cancelled", req.stream.cancelled_error())
+            self._finalize(req)
         for sid in list(self._active):
             req = self._retire(sid)
             self.adapter.release([sid])
             req.stream.finish("cancelled", req.stream.cancelled_error())
+            self._finalize(req)
 
     # -- pass stages -------------------------------------------------------
     def _expire_queue(self, now: float) -> None:
@@ -294,6 +334,7 @@ class ServingEngine:
                 rec.error(err, request_id=req.request_id,
                           tenant=req.tenant, where="queue")
             req.stream.finish("deadline", err)
+            self._finalize(req)
             self.stats["expired_queue"] += 1
 
     def _collect_preempted(self) -> None:
@@ -316,19 +357,32 @@ class ServingEngine:
         generated = list(rec.tokens[req.orig_prompt_len:])
         already = req.stream.n_tokens
         done = False
+        delivered = 0
         for tok in generated[already:]:
             req.stream.put(tok)
+            delivered += 1
             done = self._hit_limit(req, tok)
             if done:
                 break
+        self._slo_note_delivery(req, delivered)
         if done:
+            self._finalize(req)
             self.stats["completed"] += 1
             return
         req.tokens = list(rec.tokens)
         req.deadline = rec.deadline
         req.n_preemptions += 1
+        # the SLO queue-wait clock restarts here: time already spent
+        # RUNNING must not count as queue wait after the requeue
+        req.last_enqueue_t = time.perf_counter()
         self.queue.push(req, front=True)
         self.stats["preempt_requeues"] += 1
+        trec = _get_recorder()
+        if trec.enabled:
+            trec.instant("trace.requeue", cat="request",
+                         trace=trace_of(req.meta),
+                         request_id=req.request_id, reason=rec.reason,
+                         n_delivered=req.stream.n_tokens)
 
     def _priority_preempt(self) -> None:
         """When the batch is full and a strictly higher-priority request
@@ -403,6 +457,7 @@ class ServingEngine:
                     first.update(self._add_batch([r], now))
                 except AdmissionError as e:
                     r.stream.finish("error", e)
+                    self._finalize(r)
                 except (DeadlineExceeded, CapacityError, StepFailure) as e:
                     if isinstance(e, StepFailure) and not e.retry_safe:
                         self._fatal(e)
@@ -433,10 +488,21 @@ class ServingEngine:
             deadline_s=[None if r.deadline is None
                         else max(r.deadline - now, 0.0) for r in batch],
             meta=[r.meta for r in batch])
+        rec = _get_recorder()
         for sid, req in zip(sids, batch):
             self._active[sid] = req
             self._sid_of[req.request_id] = sid
             self._observe_wait(req, "admitted")
+            if rec.enabled:
+                # wait_s measures from the most recent (re)queue entry,
+                # matching the SLO queue-wait sample for this admission
+                since = (req.last_enqueue_t
+                         if req.last_enqueue_t is not None
+                         else req.enqueue_t)
+                rec.instant("trace.admit", cat="request",
+                            trace=trace_of(req.meta),
+                            request_id=req.request_id, seq_id=int(sid),
+                            wait_s=now - since)
         return first
 
     def _dispatch_engine_pass(self) -> int:
@@ -521,6 +587,7 @@ class ServingEngine:
         if req is None:
             return 0                 # raced with cancel/preempt
         n = 0
+        done = False
         for tok in toks:
             req.stream.put(tok)
             n += 1
@@ -528,8 +595,27 @@ class ServingEngine:
                 self._retire(sid)
                 self.adapter.release([sid])
                 self.stats["completed"] += 1
+                done = True
                 break
+        self._slo_note_delivery(req, n)
+        if done:
+            self._finalize(req)
         return n
+
+    def _slo_note_delivery(self, req: QueuedRequest, n: int) -> None:
+        """SLO timestamp bookkeeping shared by every path that puts
+        tokens on a stream (normal dispatch AND preempt-replay): first
+        delivery anchors TTFT, every delivery advances t_last."""
+        if n == 0 or self.slo is None:
+            return
+        now = time.perf_counter()
+        if req.t_first is None:
+            req.t_first = now
+            # client-observed TTFT: submit -> first delivered token
+            # (queue wait included — the number a user feels)
+            self.slo.observe(req.tenant, "ttft", now - req.enqueue_t,
+                             now=now)
+        req.t_last = now
 
     def _hit_limit(self, req: QueuedRequest, tok: int) -> bool:
         if tok in req.stop_tokens:
@@ -565,6 +651,7 @@ class ServingEngine:
             req.stream.finish("deadline", DeadlineExceeded(
                 f"request {req.request_id} exceeded its deadline while "
                 "running"))
+            self._finalize(req)
             self.stats["expired_running"] += 1
 
     def _finish_capacity(self, seq_ids: Sequence[int]) -> None:
@@ -576,6 +663,7 @@ class ServingEngine:
             req.stream.finish("capacity", CapacityError(
                 f"request {req.request_id} reached the compiled seq_len",
                 seq_ids=(sid,)))
+            self._finalize(req)
 
     def _fatal(self, err: StepFailure) -> None:
         """Unrecoverable device failure: every stream is failed; the
@@ -597,9 +685,11 @@ class ServingEngine:
         for sid in list(self._active):
             req = self._retire(sid)
             req.stream.finish("error", err)
+            self._finalize(req)
         for req in list(self._queued()):
             self.queue.remove(req.request_id)
             req.stream.finish("error", err)
+            self._finalize(req)
 
     # -- post-mortem surface ----------------------------------------------
     def debug_state(self) -> Dict[str, Any]:
@@ -619,7 +709,7 @@ class ServingEngine:
             for sid, req in self._active.items()}
         adapter = (self.adapter.debug_state()
                    if hasattr(self.adapter, "debug_state") else {})
-        return {
+        out = {
             "closed": self._closed,
             "stats": dict(self.stats),
             "queue": {"depth": self.queue.depth, "per_tenant": per_tenant},
@@ -627,6 +717,11 @@ class ServingEngine:
             "reserved": list(self._reserved),
             "adapter": adapter,
         }
+        if self.slo is not None:
+            # read-only SLO plane: per-tenant percentiles, burn rates and
+            # the advisory degradation hint (telemetry/slo.py)
+            out["slo"] = self.slo.report()
+        return out
 
     def dump_debug_state(self, path: Optional[str] = None,
                          error: Optional[BaseException] = None,
@@ -671,8 +766,55 @@ class ServingEngine:
                 yield req
 
     def _observe_wait(self, req: QueuedRequest, outcome: str) -> None:
+        now = time.perf_counter()
+        if self.slo is not None and outcome == "admitted":
+            # a re-admission measures from its REQUEUE time, not the
+            # original submit — time spent running is not queue wait
+            since = (req.last_enqueue_t if req.last_enqueue_t is not None
+                     else req.enqueue_t)
+            self.slo.observe(req.tenant, "queue_wait", now - since,
+                             now=now)
         reg = get_registry()
         if reg.enabled:
             tmetrics.queue_wait_histogram(reg).observe(
-                time.perf_counter() - req.enqueue_t,
+                now - req.enqueue_t,
                 tenant=req.tenant, outcome=outcome)
+
+    # -- request-trace plumbing (telemetry/request_trace.py) ---------------
+    def _remember_trace(self, request_id: str, trace_id: str,
+                        bound: int = 1024) -> None:
+        """Bounded request_id -> trace_id map behind
+        ``GET /v1/debug/trace/<id>`` (oldest entries beyond ``bound``
+        evicted — dict preserves insertion order)."""
+        self._trace_ids[request_id] = trace_id
+        while len(self._trace_ids) > bound:
+            del self._trace_ids[next(iter(self._trace_ids))]
+
+    def trace_id_of(self, request_id: str) -> Optional[str]:
+        """The trace id minted (or continued) for a request submitted to
+        THIS engine, None for unknown ids (the map is bounded — very old
+        finished requests age out)."""
+        return self._trace_ids.get(request_id)
+
+    def _finalize(self, req: QueuedRequest) -> None:
+        """Terminal request bookkeeping shared by every finish path:
+        the ``trace.emit`` lifecycle event and — with an SLO tracker
+        attached and >= 2 tokens delivered over >= 2 delivery passes —
+        the per-request mean TPOT observation. A request whose tokens
+        all landed in ONE pass (fused horizon, speculation burst,
+        preempt replay) has no delivery interval to measure: it
+        contributes no TPOT sample rather than a fake-perfect 0.0."""
+        if (self.slo is not None and req.t_first is not None
+                and req.t_last is not None and req.stream.n_tokens > 1
+                and req.t_last > req.t_first):
+            self.slo.observe(
+                req.tenant, "tpot",
+                (req.t_last - req.t_first) / (req.stream.n_tokens - 1),
+                now=req.t_last)
+        rec = _get_recorder()
+        if rec.enabled:
+            rec.instant("trace.emit", cat="request",
+                        trace=trace_of(req.meta),
+                        request_id=req.request_id, tenant=req.tenant,
+                        reason=req.stream.finish_reason,
+                        n_tokens=req.stream.n_tokens)

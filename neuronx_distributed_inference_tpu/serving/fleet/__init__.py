@@ -21,6 +21,7 @@ contract):
     single-engine run.
 """
 
+from .aggregator import FleetMetricsAggregator
 from .handoff import (HANDOFF_SCHEMA, admit_handoff, capture_handoff,
                       handoff_from_json, handoff_to_json)
 from .kv_tier import HostKVSpillTier
@@ -28,7 +29,7 @@ from .router import DEAD, DRAINING, HEALTHY, EngineRouter
 
 __all__ = [
     "EngineRouter", "HEALTHY", "DRAINING", "DEAD",
-    "HostKVSpillTier",
+    "HostKVSpillTier", "FleetMetricsAggregator",
     "HANDOFF_SCHEMA", "capture_handoff", "admit_handoff",
     "handoff_to_json", "handoff_from_json",
 ]

@@ -1,0 +1,98 @@
+"""Fleet-wide metrics aggregation — N replica registries, one scrape.
+
+A real fleet runs one registry per replica process; the in-process fleet
+(`EngineRouter(metrics_registries=...)`) keeps one dedicated
+:class:`~...telemetry.MetricsRegistry` per replica by scoping the global
+slot while each replica runs. Either way, an operator wants ONE
+Prometheus scrape for the fleet: :class:`FleetMetricsAggregator` merges
+the sources under a ``replica`` label — every series of every replica is
+re-emitted as ``name{replica="<name>",...}`` with its HELP/TYPE header
+written once — so ``nxdi_request_ttft_seconds`` from two replicas lands
+as two labeled series of one metric family, exactly what a
+fleet-latency dashboard joins on.
+
+Sources are deliberately loose: a live ``MetricsRegistry`` (read at
+scrape time), an already-taken ``snapshot()`` dict (the cross-process
+case — ship each replica's snapshot over the wire and aggregate
+centrally), or a zero-arg callable returning either. The merge is pure
+and allocation-light; nothing here runs unless someone scrapes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from ...resilience.errors import ConfigurationError
+from ...telemetry.registry import _escape_help, render_series
+
+__all__ = ["FleetMetricsAggregator"]
+
+FLEET_METRICS_SCHEMA = "nxdi-fleet-metrics-v1"
+
+
+class FleetMetricsAggregator:
+    """Merge per-replica metric sources into one exposition (see module
+    docstring). ``sources`` maps replica name -> registry | snapshot
+    dict | callable."""
+
+    def __init__(self, sources: Dict[str, Any]):
+        if not sources:
+            raise ConfigurationError(
+                "FleetMetricsAggregator needs >= 1 source")
+        self.sources = dict(sources)
+
+    # -- source resolution -------------------------------------------------
+    @staticmethod
+    def _resolve(source: Any) -> Dict[str, Any]:
+        if callable(source) and not hasattr(source, "snapshot"):
+            source = source()
+        if hasattr(source, "snapshot"):
+            source = source.snapshot()
+        if not isinstance(source, dict) or "metrics" not in source:
+            raise ConfigurationError(
+                "fleet metrics source must be a MetricsRegistry, a "
+                "snapshot() dict, or a callable returning one (got "
+                f"{type(source).__name__})")
+        return source
+
+    def snapshots(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica ``registry.snapshot()`` dicts, resolved now."""
+        return {name: self._resolve(src)
+                for name, src in sorted(self.sources.items())}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able fleet dump: the per-replica snapshots under one
+        schema header (the debug/artifact counterpart of the text
+        exposition)."""
+        return {"schema": FLEET_METRICS_SCHEMA,
+                "replicas": self.snapshots()}
+
+    # -- exposition --------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (0.0.4) of the merged fleet: one
+        HELP/TYPE header per metric family, every replica's series
+        re-labeled with ``replica=<name>`` (first label, so fleet joins
+        group naturally). Sample rendering goes through the registry's
+        own :func:`~...telemetry.registry.render_series`, so this
+        surface can never drift from the single-process exposition."""
+        # family name -> {"type", "help", "lines": [...]} in first-seen
+        # order per replica-sorted iteration (deterministic output)
+        families: Dict[str, Dict[str, Any]] = {}
+        for replica, snap in self.snapshots().items():
+            for name in sorted(snap["metrics"]):
+                fam = snap["metrics"][name]
+                slot = families.setdefault(
+                    name, {"type": fam["type"], "help": fam.get("help", ""),
+                           "lines": []})
+                for series in fam["series"]:
+                    slot["lines"].extend(render_series(
+                        name, fam["type"], series,
+                        extra_labels={"replica": replica}))
+        out: List[str] = []
+        for name in sorted(families):
+            fam = families[name]
+            if fam["help"]:
+                out.append(f"# HELP {name} {_escape_help(fam['help'])}")
+            out.append(f"# TYPE {name} {fam['type']}")
+            out.extend(fam["lines"])
+        return "\n".join(out) + "\n" if out else ""

@@ -39,6 +39,7 @@ from ...resilience.faults import FAULTS as _FAULTS
 from ...resilience.preemption import Preempted
 from ...telemetry import get_registry
 from ...telemetry import metrics as tmetrics
+from ...telemetry.request_trace import trace_of
 from ...telemetry.trace import get_recorder as _get_recorder
 
 __all__ = ["HANDOFF_SCHEMA", "capture_handoff", "admit_handoff",
@@ -103,9 +104,11 @@ def capture_handoff(adapter, seq_id: int,
     }
     rec = _get_recorder()
     if rec.enabled:
+        # meta rides the record verbatim, so the trace id recorded here
+        # is the SAME one the decode side stitches onto at admit
         rec.instant("handoff.send", cat="fleet", seq_id=int(seq_id),
                     tokens=len(pre.tokens), blocks=len(kv_blocks),
-                    engine=adapter.engine_name)
+                    engine=adapter.engine_name, trace=trace_of(pre.meta))
     reg = get_registry()
     if reg.enabled:
         tmetrics.handoffs_counter(reg).inc(role="send")
@@ -154,7 +157,7 @@ def admit_handoff(adapter, record: Dict[str, Any], seq_id: int,
     if rec.enabled:
         rec.instant("handoff.recv", cat="fleet", seq_id=int(seq_id),
                     tokens=len(pre.tokens), blocks=len(payloads),
-                    engine=adapter.engine_name)
+                    engine=adapter.engine_name, trace=trace_of(pre.meta))
     reg = get_registry()
     if reg.enabled:
         tmetrics.handoffs_counter(reg).inc(role="recv")

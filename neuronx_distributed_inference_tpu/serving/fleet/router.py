@@ -33,6 +33,7 @@ failovers. Routing/drain/requeue decisions land on the flight recorder
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import time
 from dataclasses import dataclass, field
@@ -41,10 +42,12 @@ from typing import Any, Dict, List, Optional, Sequence
 from ...resilience.errors import (ConfigurationError, ReplicaUnavailable,
                                   ServingError, StepFailure)
 from ...resilience.preemption import Preempted
-from ...telemetry import get_registry
+from ...telemetry import get_registry, set_registry
 from ...telemetry import metrics as tmetrics
+from ...telemetry.request_trace import new_trace_id, trace_of
 from ...telemetry.trace import get_recorder as _get_recorder
 from ..engine.streams import TokenStream
+from .aggregator import FleetMetricsAggregator
 
 __all__ = ["EngineRouter", "HEALTHY", "DRAINING", "DEAD"]
 
@@ -88,9 +91,21 @@ class EngineRouter:
 
     ``replicas`` maps replica name -> engine (an iterable of engines gets
     auto-names ``r0..rN-1``). ``max_requeues`` bounds how many replica
-    failures one request may survive before its stream fails typed."""
+    failures one request may survive before its stream fails typed.
 
-    def __init__(self, replicas, *, max_requeues: int = 2):
+    ``metrics_registries`` (optional) maps replica name -> a dedicated
+    :class:`~...telemetry.MetricsRegistry`: the router then scopes the
+    process-global registry to that replica's own while driving it
+    (submit / run_pass / failover resubmit — the engine reads the global
+    registry at call time), so each replica accumulates its OWN series in
+    one process exactly as N processes would, and
+    :attr:`EngineRouter.aggregator` serves the fleet-wide merged
+    exposition (every series labeled ``replica=<name>``) behind
+    ``GET /v1/metrics``. Without it, replicas share the global registry
+    and ``aggregator`` is None."""
+
+    def __init__(self, replicas, *, max_requeues: int = 2,
+                 metrics_registries: Optional[Dict[str, Any]] = None):
         if not isinstance(replicas, dict):
             replicas = {f"r{i}": e for i, e in enumerate(replicas)}
         if not replicas:
@@ -102,13 +117,44 @@ class EngineRouter:
         self.replicas: Dict[str, _Replica] = {
             name: _Replica(name, eng) for name, eng in replicas.items()}
         self.max_requeues = max_requeues
+        if metrics_registries is not None:
+            unknown = set(metrics_registries) - set(self.replicas)
+            missing = set(self.replicas) - set(metrics_registries)
+            if unknown or missing:
+                # partial coverage is worse than none: an uncovered
+                # replica's series land in the process-global registry
+                # and the aggregated scrape silently omits them
+                raise ConfigurationError(
+                    "metrics_registries must cover every replica exactly "
+                    f"(unknown: {sorted(unknown)}, missing: "
+                    f"{sorted(missing)}; replicas: {sorted(self.replicas)})")
+        self._registries = metrics_registries
+        self.aggregator = (FleetMetricsAggregator(metrics_registries)
+                           if metrics_registries else None)
         self._requests: Dict[str, _FleetRequest] = {}
         self._done: List[str] = []     # newest finished ids (bounded)
+        self._traces: Dict[str, str] = {}   # request_id -> trace (bounded)
         self._rid_counter = itertools.count()
         self.stats: Dict[str, int] = {
             "routed": 0, "affinity_warm": 0, "affinity_cold": 0,
             "requeues": 0, "replica_failures": 0, "completed": 0,
             "drains": 0}
+
+    @contextlib.contextmanager
+    def _scoped_registry(self, name: str):
+        """Swap the replica's dedicated registry into the global slot
+        while its engine runs (no-op without ``metrics_registries``).
+        The engine and adapter read ``get_registry()`` at call time, so
+        this is all the isolation one process needs."""
+        if self._registries is None or name not in self._registries:
+            yield
+            return
+        prev = get_registry()
+        set_registry(self._registries[name])
+        try:
+            yield
+        finally:
+            set_registry(prev)
 
     # -- public surface ----------------------------------------------------
     def submit(self, tokens: Sequence[int], max_new_tokens: int, *,
@@ -126,23 +172,65 @@ class EngineRouter:
         if rid in self._requests:
             raise ServingError(f"request_id {rid!r} already in flight")
         now = time.perf_counter()
+        # the FLEET owns the request trace: the replica engine continues
+        # this id rather than minting its own, so one trace follows the
+        # request across failovers and replicas
+        tid = new_trace_id()
         req = _FleetRequest(
             request_id=rid, prompt=tokens, max_new_tokens=max_new_tokens,
             tenant=tenant, priority=priority,
             deadline=None if deadline_s is None else now + deadline_s,
             stop_tokens=tuple(int(t) for t in stop_tokens),
-            stream=TokenStream(rid, tenant))
+            stream=TokenStream(rid, tenant),
+            meta={"request_id": rid, "tenant": tenant,
+                  "priority": priority, "trace": tid})
         name, warmth = self._pick(tokens)
         rep = self.replicas[name]
-        req.inner = rep.engine.submit(
-            tokens, max_new_tokens, tenant=tenant, priority=priority,
-            deadline_s=deadline_s, stop_tokens=stop_tokens,
-            request_id=rid)
+        with self._scoped_registry(name):
+            req.inner = rep.engine.submit(
+                tokens, max_new_tokens, tenant=tenant, priority=priority,
+                deadline_s=deadline_s, stop_tokens=stop_tokens,
+                request_id=rid, trace_id=tid)
         req.replica = name
         req.stream._cancel_cb = lambda: self.cancel(rid)
         self._requests[rid] = req
+        self._traces[rid] = tid
+        while len(self._traces) > 1024:      # bounded, like _done
+            del self._traces[next(iter(self._traces))]
         self._note_route(req, name, warmth, requeue=False)
         return req.stream
+
+    def trace_id_of(self, request_id: str) -> Optional[str]:
+        """The fleet-level trace id of a request submitted through this
+        router (None for unknown/aged-out ids) — the lookup behind
+        ``GET /v1/debug/trace/<id>`` on a fleet frontend."""
+        return self._traces.get(request_id)
+
+    def export_slo(self) -> None:
+        """Export every replica engine's SLO gauges into that replica's
+        OWN registry — called by the frontend's ``GET /v1/metrics`` path
+        so the fleet-aggregated scrape carries the ``nxdi_slo_*`` series
+        too. A no-op without ``metrics_registries``: the gauges carry no
+        replica label of their own, so exporting N trackers into one
+        shared registry would let the last replica silently overwrite
+        the others."""
+        if self._registries is None:
+            return
+        for name, rep in self.replicas.items():
+            slo = getattr(rep.engine, "slo", None)
+            if slo is not None:
+                slo.export(self._registries[name])
+
+    def registry_of(self, engine) -> Optional[Any]:
+        """The scoped registry of the replica wrapping ``engine`` (id
+        comparison), or None — the frontend uses this to export ITS
+        engine's scrape-time SLO gauges into the right source."""
+        if self._registries is None:
+            return None
+        for name, rep in self.replicas.items():
+            if rep.engine is engine:
+                return self._registries[name]
+        return None
 
     def cancel(self, request_id: str) -> bool:
         """Cancel wherever the request currently lives; returns False for
@@ -172,7 +260,8 @@ class EngineRouter:
                 self._mark_dead(rep, reason="closed")
                 continue
             try:
-                rep.engine.run_pass()
+                with self._scoped_registry(rep.name):
+                    rep.engine.run_pass()
             except StepFailure as e:
                 if e.retry_safe:
                     continue           # engine retries next pass
@@ -320,14 +409,18 @@ class EngineRouter:
         rec = Preempted(
             seq_id=-1, tokens=tuple(req.prompt + req.stream.tokens),
             prompt_len=len(req.prompt), n_generated=delivered,
+            # req.meta carries the fleet trace id, so the surviving
+            # replica's continuation stitches onto the SAME trace
             reason="replica_failure", deadline=req.deadline,
-            meta={"request_id": req.request_id, "tenant": req.tenant,
-                  "priority": req.priority})
+            meta=dict(req.meta) if req.meta else
+            {"request_id": req.request_id, "tenant": req.tenant,
+             "priority": req.priority})
         try:
             name, warmth = self._pick(rec.tokens)
-            req.inner = self.replicas[name].engine.submit_record(
-                rec, remaining, stop_tokens=req.stop_tokens,
-                request_id=req.request_id)
+            with self._scoped_registry(name):
+                req.inner = self.replicas[name].engine.submit_record(
+                    rec, remaining, stop_tokens=req.stop_tokens,
+                    request_id=req.request_id)
         except ServingError as e:
             self._finish(req, "error", e)
             return
@@ -336,6 +429,14 @@ class EngineRouter:
         req.n_requeues += 1
         self.stats["requeues"] += 1
         self._note_route(req, name, warmth, requeue=True)
+        trec = _get_recorder()
+        if trec.enabled:
+            trec.instant("trace.requeue", cat="request",
+                         trace=trace_of(rec.meta),
+                         request_id=req.request_id,
+                         reason="replica_failure",
+                         from_replica=failed, to_replica=name,
+                         n_delivered=delivered)
         reg = get_registry()
         if reg.enabled:
             tmetrics.fleet_requeues_counter(reg).inc(replica=failed)

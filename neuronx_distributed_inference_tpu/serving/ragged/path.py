@@ -49,6 +49,7 @@ from ...modules.block_kv_cache import slots_from_table
 from ...resilience.errors import (CapacityError, ConfigurationError,
                                   ServingError, StepFailure)
 from ...resilience.faults import FAULTS as _FAULTS
+from ...telemetry.request_trace import trace_of as _trace_of
 from ...telemetry.trace import get_recorder as _get_recorder
 from ..adapter import (_async_fetch, _common_tenant, _live_rows,
                        _meta_tenant, _pre_step_checks, _repeat_row0,
@@ -467,8 +468,23 @@ class RaggedDispatchPath:
                         kinds={r.kind: sum(1 for x in rows
                                            if x.kind == r.kind)
                                for r in rows},
-                        seq_ids=[int(r.seq_id) for r in rows])
+                        seq_ids=[int(r.seq_id) for r in rows],
+                        # per-row request trace ids (aligned with
+                        # seq_ids), so a request's trace lane shows
+                        # every ragged dispatch it occupied a row of
+                        traces=[self._row_trace(r.seq_id) for r in rows])
         return out
+
+    def _row_trace(self, seq_id: int):
+        """The request trace id behind one packed row — live rows carry
+        meta on their _SeqState, pending prefill rows on their chunk
+        state. Recorder-enabled path only (never called while tracing
+        is off)."""
+        ad = self.adapter
+        st = ad.seqs.get(seq_id)
+        meta = st.meta if st is not None else getattr(
+            ad._chunks.get(seq_id), "meta", None)
+        return _trace_of(meta)
 
     def _fetch_ragged(self, out, b: int):
         """The ONE blocking sync of a ragged engine step."""

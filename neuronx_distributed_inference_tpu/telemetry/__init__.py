@@ -19,6 +19,9 @@ from . import metrics
 from .registry import (DEFAULT_LATENCY_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, NULL_REGISTRY, NullRegistry, disable,
                        enable, get_registry, set_registry)
+from .request_trace import (chrome_by_trace, new_trace_id, trace_events,
+                            trace_of)
+from .slo import RollingWindow, SLOPolicy, SLOTracker
 from .spans import NULL_SPAN, NullSpan, Span
 from .trace import (FlightRecorder, NULL_RECORDER, NullFlightRecorder,
                     disable_recorder, enable_recorder, get_recorder,
@@ -32,4 +35,6 @@ __all__ = [
     "Span", "NullSpan", "NULL_SPAN",
     "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
     "enable_recorder", "disable_recorder", "get_recorder", "set_recorder",
+    "new_trace_id", "trace_of", "trace_events", "chrome_by_trace",
+    "RollingWindow", "SLOPolicy", "SLOTracker",
 ]

@@ -93,6 +93,11 @@ KV_SPILL_BYTES = "nxdi_kv_spill_bytes"
 KV_RESTORE_BLOCKS_TOTAL = "nxdi_kv_restore_blocks_total"
 KV_RESTORE_TOKENS_TOTAL = "nxdi_kv_restore_tokens_total"
 
+# -- per-tenant SLO plane (telemetry/slo.py) ---------------------------------
+# signal: ttft|tpot|queue_wait ; window: short|long (policy window lengths)
+SLO_ATTAINMENT = "nxdi_slo_attainment"               # tenant, signal, window
+SLO_BURN_RATE = "nxdi_slo_burn_rate"                 # tenant, signal, window
+
 # -- degradations -----------------------------------------------------------
 MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
     "nxdi_moe_tkg_local_quant_degraded_total"
@@ -463,6 +468,24 @@ def kv_restore_tokens_counter(reg):
         KV_RESTORE_TOKENS_TOTAL,
         "Prompt tokens whose prefill recompute was replaced by a "
         "spill-tier restore")
+
+
+def slo_attainment_gauge(reg):
+    return reg.gauge(
+        SLO_ATTAINMENT,
+        "Fraction of a tenant's requests meeting the signal's SLO target "
+        "inside the window (signal=ttft|tpot|queue_wait, "
+        "window=short|long; pull-time export from the SLO tracker)",
+        labels=("tenant", "signal", "window"))
+
+
+def slo_burn_rate_gauge(reg):
+    return reg.gauge(
+        SLO_BURN_RATE,
+        "Error-budget burn rate inside the window: violation fraction / "
+        "(1 - objective) — 1.0 means spending budget exactly as fast as "
+        "the objective allows",
+        labels=("tenant", "signal", "window"))
 
 
 def moe_tkg_degraded_counter(reg):

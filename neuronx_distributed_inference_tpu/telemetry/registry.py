@@ -27,6 +27,7 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "NullRegistry", "NULL_REGISTRY",
     "get_registry", "set_registry", "enable", "disable",
+    "render_series",
 ]
 
 # Log-spaced latency ladder (seconds), 100 us .. 60 s. Fixed so that series
@@ -71,6 +72,36 @@ def _labels_key(label_names: Tuple[str, ...], labels: Dict[str, Any]
     return tuple(str(labels[k]) for k in label_names)
 
 
+def _labels_to_text(labels: Dict[str, Any]) -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"' for k, v in labels.items()]
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_series(name: str, kind: str, entry: Dict[str, Any],
+                  extra_labels: Optional[Dict[str, str]] = None
+                  ) -> List[str]:
+    """Prometheus sample lines for ONE ``snapshot()`` series entry —
+    THE snapshot-driven renderer, shared by the live registry's own
+    ``render_prometheus()`` and the fleet aggregator
+    (serving/fleet/aggregator.py), so the two exposition surfaces can
+    never drift apart. ``extra_labels`` are prepended (the aggregator's
+    ``replica`` label)."""
+    labels = dict(extra_labels or {})
+    labels.update(entry["labels"])
+    lt = _labels_to_text(labels)
+    if kind == "histogram":
+        lines = []
+        for bound, cum in entry["buckets"]:
+            le = _labels_to_text({**labels, "le": _fmt(bound)})
+            lines.append(f"{name}_bucket{le} {cum}")
+        inf = _labels_to_text({**labels, "le": "+Inf"})
+        lines.append(f"{name}_bucket{inf} {entry['count']}")
+        lines.append(f"{name}_sum{lt} {_fmt(entry['sum'])}")
+        lines.append(f"{name}_count{lt} {entry['count']}")
+        return lines
+    return [f"{name}{lt} {_fmt(entry['value'])}"]
+
+
 class _Metric:
     kind = "untyped"
 
@@ -85,13 +116,11 @@ class _Metric:
         self._series: Dict[Tuple[str, ...], Any] = {}
         self._lock = threading.Lock()
 
-    def _label_text(self, key: Tuple[str, ...],
-                    extra: str = "") -> str:
-        parts = [f'{n}="{_escape_label(v)}"'
-                 for n, v in zip(self.label_names, key)]
-        if extra:
-            parts.append(extra)
-        return "{" + ",".join(parts) + "}" if parts else ""
+    def _render(self) -> List[str]:
+        # snapshot-driven, through THE shared renderer (render_series) —
+        # the fleet aggregator rides the same code path
+        return [line for entry in self._snapshot()
+                for line in render_series(self.name, self.kind, entry)]
 
 
 class Counter(_Metric):
@@ -108,11 +137,6 @@ class Counter(_Metric):
 
     def get(self, **labels) -> float:
         return self._series.get(_labels_key(self.label_names, labels), 0.0)
-
-    def _render(self) -> List[str]:
-        with self._lock:
-            return [f"{self.name}{self._label_text(k)} {_fmt(v)}"
-                    for k, v in sorted(self._series.items())]
 
     def _snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:
@@ -141,7 +165,6 @@ class Gauge(_Metric):
     def get(self, **labels) -> float:
         return self._series.get(_labels_key(self.label_names, labels), 0.0)
 
-    _render = Counter._render
     _snapshot = Counter._snapshot
 
 
@@ -202,25 +225,6 @@ class Histogram(_Metric):
             acc += c
             out.append(acc)
         return out
-
-    def _render(self) -> List[str]:
-        lines: List[str] = []
-        with self._lock:
-            for key, st in sorted(self._series.items()):
-                cum = self._cumulative(st)
-                for b, c in zip(self.buckets, cum):
-                    le = 'le="%s"' % _fmt(b)
-                    lines.append(
-                        f"{self.name}_bucket{self._label_text(key, le)} {c}")
-                inf = 'le="+Inf"'
-                lines.append(
-                    f"{self.name}_bucket{self._label_text(key, inf)} "
-                    f"{st['count']}")
-                lines.append(f"{self.name}_sum{self._label_text(key)} "
-                             f"{_fmt(st['sum'])}")
-                lines.append(f"{self.name}_count{self._label_text(key)} "
-                             f"{st['count']}")
-        return lines
 
     def _snapshot(self) -> List[Dict[str, Any]]:
         with self._lock:

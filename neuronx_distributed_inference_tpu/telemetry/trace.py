@@ -58,14 +58,19 @@ ENGINE_PASS_PHASES = ("pass.expire", "pass.preempt", "pass.admit",
 #:   ``stream.deliver``         tokens routed to request streams
 ENGINE_EVENTS = ("stream.deliver",)
 
-#: Adapter boundary events (serving/adapter.py). STABLE names.
+#: Adapter boundary events (serving/adapter.py + serving/ragged/path.py).
+#: STABLE names.
 #:   ``dispatch.decode``        one decode dispatch (eager or pipelined)
 #:   ``dispatch.decode_loop``   one fused step_many(k) dispatch
 #:   ``dispatch.prefill_chunk`` one packed prefill-chunk dispatch
+#:   ``dispatch.ragged``        THE unified mixed dispatch of a ragged
+#:                              engine step (serving/ragged/; carries
+#:                              per-row ``seq_ids`` and ``traces``)
 #:   ``fetch.tokens``           a blocking device->host token fetch
 #:   ``preempt``                one sequence evicted (any reason)
 ADAPTER_EVENTS = ("dispatch.decode", "dispatch.decode_loop",
-                  "dispatch.prefill_chunk", "fetch.tokens", "preempt")
+                  "dispatch.prefill_chunk", "dispatch.ragged",
+                  "fetch.tokens", "preempt")
 
 #: Application events (models/application.py). STABLE names.
 #:   ``run.<kind>``   host window of one _run_* call (entry -> dispatch
@@ -87,11 +92,27 @@ APP_EVENTS = ("run.prefill", "run.decode", "run.decode_loop", "run.paged",
 FLEET_EVENTS = ("fleet.route", "fleet.drain", "kv.spill", "kv.restore",
                 "handoff.send", "handoff.recv")
 
+#: Request-trace lifecycle events (telemetry/request_trace.py +
+#: serving/engine/scheduler.py + serving/fleet/router.py). STABLE names.
+#: Every one carries ``trace`` — the request's stable trace id
+#: (``meta["trace"]``), which also rides ``Preempted``/handoff records
+#: across replicas so a continuation stitches onto the same trace.
+#:   ``trace.begin``    frontend/router/engine ingress (request_id,
+#:                      tenant, prompt_len, deadline_s)
+#:   ``trace.admit``    the request left the queue into one transactional
+#:                      admission (seq_id, wait_s)
+#:   ``trace.requeue``  the request went back to a queue — preemption or
+#:                      replica failover (reason, replica when fleet)
+#:   ``trace.emit``     terminal emission (reason, n_tokens)
+TRACE_EVENTS = ("trace.begin", "trace.admit", "trace.requeue",
+                "trace.emit")
+
 EVENT_NAMES = (ENGINE_PASS_PHASES + ENGINE_EVENTS + ADAPTER_EVENTS
-               + APP_EVENTS + FLEET_EVENTS)
+               + APP_EVENTS + FLEET_EVENTS + TRACE_EVENTS)
 
 #: Category -> Chrome trace tid lane (deterministic ordering in the UI).
-_CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4, "fleet": 5}
+_CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4, "fleet": 5,
+             "request": 6}
 
 
 class _TraceSpan:
@@ -128,9 +149,10 @@ class FlightRecorder:
         self.epoch = time.perf_counter()   # chrome ts origin
         self._events: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()
         self._ids = itertools.count()
         self.dropped = 0
-        self._dropped_unflushed = 0
+        self._dropped_flushed = 0      # high-water mark already counted
 
     # -- recording ---------------------------------------------------------
     def _push(self, ev: Dict[str, Any]) -> str:
@@ -141,19 +163,30 @@ class FlightRecorder:
             if excess > 0:
                 del self._events[:excess]
                 self.dropped += excess
-                self._dropped_unflushed += excess
         return eid
 
     def _flush_drops(self) -> None:
         """Report accumulated ring evictions to the metrics registry.
         Deferred off the per-event hot path (once the ring is full EVERY
         push evicts) onto the read/export surfaces, where the count is
-        actually consumed."""
-        with self._lock:
-            n, self._dropped_unflushed = self._dropped_unflushed, 0
-        if n:
-            reg = get_registry()
-            if reg.enabled:
+        actually consumed.
+
+        Accounting is delta-against-a-high-water-mark, serialized by its
+        own lock: concurrent ``tail()``/``events()`` exports each flush
+        exactly the drops no other flush has claimed yet (never the same
+        delta twice), and a flush while the registry is disabled counts
+        NOTHING as flushed — the drops are reported, not lost, once a
+        live registry is back. Invariant (regression-pinned):
+        ``nxdi_trace_events_dropped_total{ring="trace"}`` on one live
+        registry equals ``self.dropped`` after any export."""
+        reg = get_registry()
+        if not reg.enabled:
+            return                 # deferred, not discarded
+        with self._flush_lock:
+            with self._lock:
+                n = self.dropped - self._dropped_flushed
+                self._dropped_flushed += n
+            if n:
                 from . import metrics as tmetrics
                 tmetrics.trace_events_dropped_counter(reg).inc(n,
                                                                ring="trace")
@@ -205,7 +238,7 @@ class FlightRecorder:
         with self._lock:
             self._events.clear()
             self.dropped = 0
-            self._dropped_unflushed = 0
+            self._dropped_flushed = 0
 
     # -- reading -----------------------------------------------------------
     def events(self) -> List[Dict[str, Any]]:
