@@ -775,6 +775,69 @@ def slo_report_main(artifact_path="artifacts/bench_slo_r14.json"):
     _emit_report_artifact(payload, artifact_path, "slo-report")
 
 
+def chaos_report_main(artifact_path="artifacts/bench_chaos_r15.json"):
+    """CPU-runnable chaos campaign (ISSUE 15): sweep EVERY registered
+    fault point — single-shot and repeated-Nth schedules — against a
+    seeded staggered mixed fleet workload (chunked prefill + decode +
+    speculative verify + ragged unified dispatch + KV spill tier +
+    disaggregated handoff + replica failover on three tiny same-weights
+    engines), asserting the global invariants after every heal: streams
+    bit-identical to the fault-free golden (requeues included), no
+    stream lost, exact free-pool accounting, zero unwritten-block
+    leaks, and every armed point actually fired. One parseable JSON
+    line + the per-point outcome artifact; no TPU required. rc 1 when
+    any cell is red — a chaos regression IS a regression."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.resilience.chaos import \
+        ChaosCampaign
+
+    hf = _tiny_llama_hf()
+
+    def make_app():
+        # replicas of ONE model: same weights seed on every app
+        tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
+                         enable_bucketing=True,
+                         context_encoding_buckets=[16],
+                         is_block_kv_layout=True, pa_block_size=8,
+                         is_prefix_caching=True)
+        app = PagedCausalLMApplication(None,
+                                       LlamaInferenceConfig(tcfg, **hf),
+                                       LlamaFamily)
+        app.init_random_weights(seed=7).init_cache()
+        return app
+
+    campaign = ChaosCampaign([make_app() for _ in range(3)], seed=0)
+    report = campaign.run()
+    failed = [c for c in report["cells"] if not c["ok"]]
+    payload = {
+        "metric": "chaos_failed_cells",
+        "value": len(failed),
+        "unit": f"red_cells_of_{len(report['cells'])}_point_schedules",
+        "details": {
+            "schema": report["schema"],
+            "ok": report["ok"],
+            "seed": report["seed"],
+            "points": report["points"],
+            "golden": report["golden"],
+            "cells": report["cells"],
+            "wall_s": report["wall_s"],
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "chaos-report")
+    return 0 if report["ok"] else 1
+
+
 def graph_report_main(artifact_path="artifacts/graph_report_r08.json"):
     """CPU-runnable compiled-graph observatory report (ISSUE 7): AOT
     ``.lower().compile()`` of every bucket-ladder graph of the tiny
@@ -953,10 +1016,13 @@ def _no_tpu_fallback(error: str):
                      ("serving_load", serving_load_main),
                      ("fleet_load", fleet_load_main),
                      ("slo_report", slo_report_main),
+                     ("chaos_report", chaos_report_main),
                      ("graph_report", graph_report_main),
                      ("lint_report", lint_report_main)):
         try:
-            fn()
+            rc = fn()
+            if rc:              # chaos/lint reports return 1 on red
+                extra[name + "_rc"] = rc
         except Exception as e:  # pragma: no cover - defensive
             extra[name + "_error"] = str(e)[:200]
     # the sharding report needs a dp2xtp2 CPU mesh, but this process's
@@ -1007,6 +1073,8 @@ def main():
         return fleet_load_main()
     if "--slo-report" in sys.argv[1:]:
         return slo_report_main()
+    if "--chaos-report" in sys.argv[1:]:
+        return chaos_report_main()
     if "--graph-report" in sys.argv[1:]:
         return graph_report_main()
     if "--sharding-report" in sys.argv[1:]:
@@ -1260,4 +1328,7 @@ def _tpu_bench_main():
 
 
 if __name__ == "__main__":
-    main()
+    # propagate per-mode return codes (chaos/lint reports return 1 on a
+    # red result — a regression must fail the invoking CI step); mains
+    # returning None still exit 0
+    sys.exit(main())

@@ -95,7 +95,7 @@ def run_passes(repo_root, names: Optional[Sequence[str]] = None,
         report.passes.append(PassStats(
             name=p.name, description=p.description,
             files=len(pass_paths if pass_paths is not None
-                      else p.default_paths),
+                      else p.effective_paths(ctx)),
             findings=len(kept), suppressed=len(suppressed),
             duration_s=time.perf_counter() - t0))
     # unused-suppression check: every disable comment in a scanned file

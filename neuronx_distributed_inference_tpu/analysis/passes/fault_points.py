@@ -1,0 +1,171 @@
+"""fault-points: the fault registry and its call sites cannot drift.
+
+``FAULTS.inject()`` already rejects unknown names at ARMING time, but
+nothing checked the other direction: a ``FAULTS.fire("typo")`` call
+site in the library silently never fires (the injector looks the name
+up and finds nothing armed), and a registered point whose last call
+site was refactored away silently stops being testable — the chaos
+campaign would sweep a point that can never trip. Symmetric, like the
+metric-names pass:
+
+  * every ``*.fire("<name>")`` / ``*.inject("<name>")`` call on a
+    FAULTS-named receiver in the scanned tree must use a name in
+    ``resilience/faults.py``'s ``FAULT_POINTS`` (a non-constant name
+    argument is flagged too — it cannot be statically checked and the
+    registry is a stable contract, so call sites spell names
+    literally);
+  * every registered point must have >= 1 ``fire`` call site (orphaned
+    points are findings).
+
+Default file set: discovered — every ``.py`` under ``serving/``,
+``modules/`` and ``resilience/`` (where fault points live by design)
+plus the registry file itself. An explicit ``paths`` override (tests,
+doctored copies) uses exactly the given files, reading ``FAULT_POINTS``
+from whichever of them defines it (falling back to the repo registry).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..findings import Finding
+from ..registry import LintContext, Pass, register
+
+REGISTRY_PATH = "neuronx_distributed_inference_tpu/resilience/faults.py"
+
+_SCAN_ROOTS = (
+    "neuronx_distributed_inference_tpu/serving",
+    "neuronx_distributed_inference_tpu/modules",
+    "neuronx_distributed_inference_tpu/resilience",
+)
+
+_CALLS = ("fire", "inject")
+
+
+def registered_points(tree: ast.AST) -> Optional[Tuple[str, ...]]:
+    """The ``FAULT_POINTS`` tuple of string constants, or None when the
+    file does not define one."""
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "FAULT_POINTS"
+                   for t in node.targets):
+            continue
+        value = node.value
+        if isinstance(value, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in value.elts):
+            return tuple(e.value for e in value.elts)
+    return None
+
+
+def fault_calls(tree: ast.AST) -> List[Tuple[int, str, Optional[str]]]:
+    """(lineno, method, point-name-or-None) for every ``fire``/``inject``
+    call whose receiver name mentions FAULTS (``FAULTS.fire``,
+    ``_FAULTS.fire``, ``self.faults.inject`` do; unrelated ``x.fire``
+    does not). ``None`` marks a non-constant name argument."""
+    out: List[Tuple[int, str, Optional[str]]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr in _CALLS):
+            continue
+        recv = fn.value
+        recv_name = (recv.id if isinstance(recv, ast.Name)
+                     else recv.attr if isinstance(recv, ast.Attribute)
+                     else "")
+        if "FAULTS" not in recv_name.upper():
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        name = (arg.value if isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str) else None)
+        out.append((node.lineno, fn.attr, name))
+    return out
+
+
+@register
+class FaultPointsPass(Pass):
+    name = "fault-points"
+    description = ("every FAULTS.fire()/inject() call site names a "
+                   "registered fault point and every registered point "
+                   "has >= 1 fire call site (symmetric, like "
+                   "metric-names)")
+    default_paths = (REGISTRY_PATH,)
+
+    def effective_paths(self, ctx: LintContext) -> List[str]:
+        # discovered coverage: the per-pass `files` stat in the report
+        # must state the scanned set, not the 1-file default anchor
+        return self._discover(ctx)
+
+    def _discover(self, ctx: LintContext) -> List[str]:
+        rels: Set[str] = {REGISTRY_PATH}
+        for root in _SCAN_ROOTS:
+            base = ctx.repo_root / root
+            if base.is_dir():
+                rels.update(
+                    p.relative_to(ctx.repo_root).as_posix()
+                    for p in base.rglob("*.py"))
+        return sorted(rels)
+
+    def run(self, ctx: LintContext,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        findings: List[Finding] = []
+        rels = (list(paths) if paths is not None
+                else self._discover(ctx))
+        sources = self._sources(ctx, rels, findings)
+        # the registry: the first scanned file defining FAULT_POINTS
+        # (doctored-copy override), else the repo's canonical one
+        points: Optional[Tuple[str, ...]] = None
+        reg_rel = REGISTRY_PATH
+        for sf in sources:
+            pts = registered_points(sf.tree)
+            if pts is not None:
+                points, reg_rel = pts, sf.rel
+                break
+        if points is None:
+            reg = ctx.source(REGISTRY_PATH)
+            if reg is None or reg.tree is None or \
+                    (points := registered_points(reg.tree)) is None:
+                findings.append(Finding(
+                    self.name, REGISTRY_PATH, 0,
+                    "FAULT_POINTS tuple of string constants not found — "
+                    "the fault registry moved or lost its literal form"))
+                return findings
+        fired: Set[str] = set()
+        for sf in sources:
+            for lineno, method, point in fault_calls(sf.tree):
+                if point is None:
+                    # a parameterized inject() (the chaos campaign's
+                    # schedule driver) validates at arming time; a
+                    # parameterized FIRE would dodge both checks
+                    if method == "fire":
+                        findings.append(Finding(
+                            self.name, sf.rel, lineno,
+                            "FAULTS.fire() with a non-literal point "
+                            "name — the registry is a stable contract; "
+                            "spell the point as a string literal so "
+                            "this pass can check it"))
+                    continue
+                if point not in points:
+                    findings.append(Finding(
+                        self.name, sf.rel, lineno,
+                        f"FAULTS.{method}({point!r}) is not a "
+                        f"registered fault point ({reg_rel}) — a typo'd "
+                        "point silently never fires; known: "
+                        f"{list(points)}"))
+                elif method == "fire":
+                    fired.add(point)
+        for point in points:
+            if point not in fired:
+                findings.append(Finding(
+                    self.name, reg_rel, 0,
+                    f"registered fault point {point!r} has no "
+                    "FAULTS.fire() call site in the scanned tree — an "
+                    "orphaned point can never trip, so every recovery "
+                    "path claiming to test it is vacuous"))
+        return findings

@@ -66,6 +66,12 @@ class Pass:
             paths: Optional[Sequence[str]] = None) -> List[Finding]:
         raise NotImplementedError
 
+    def effective_paths(self, ctx: LintContext) -> Sequence[str]:
+        """The file set a default-paths run actually covers — passes
+        with DISCOVERED coverage (fault-points) override this so the
+        report's per-pass ``files`` stat states the truth."""
+        return self.default_paths
+
     # shared helper: resolve the file list, emitting missing-file findings
     def _sources(self, ctx: LintContext, paths: Optional[Sequence[str]],
                  findings: List[Finding]):

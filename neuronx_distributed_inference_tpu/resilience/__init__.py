@@ -10,6 +10,7 @@ preemption under KV pressure, deadline budgets — is exercised on CPU by
 arming the fault points in :mod:`.faults`; no TPU, no flakiness.
 """
 
+from .controller import DEGRADE_ACTIONS, DegradationController
 from .errors import (AdmissionError, Cancelled, CapacityError,
                      ConfigurationError, DeadlineExceeded, HandoffError,
                      KVCacheStateError, QueueOverflow, ReplicaUnavailable,
@@ -24,4 +25,5 @@ __all__ = [
     "ReplicaUnavailable", "HandoffError",
     "FAULTS", "FAULT_POINTS", "FaultInjector", "InjectedFault",
     "Preempted", "PREEMPTION_POLICIES", "pick_victim",
+    "DEGRADE_ACTIONS", "DegradationController",
 ]

@@ -650,6 +650,12 @@ class _EngineAdapterBase:
         self._scratch = None
         self._spec = None              # SpeculativeDecodePath (paged only)
         self._ragged = None            # RaggedDispatchPath (paged only)
+        # degradation-controller actuators (resilience/controller.py):
+        # shed flags are consulted per step, so flipping them mid-serve
+        # changes DISPATCH SHAPE only — greedy token streams are
+        # unaffected (pinned by tests/test_resilience_control.py)
+        self._spec_shed = False        # clamp draft widths to 1 (no draft)
+        self._ragged_shed = False      # ragged -> two-phase dispatching
         # plain-int host counters (always on — they feed the CPU
         # microbenches, bench.py --host-overhead / --prefill-overhead).
         # The decode counters (dispatches/blocking_fetches/...) count ONLY
@@ -1465,9 +1471,21 @@ class PagedEngineAdapter(_EngineAdapterBase):
         tokens per row; ``token_room`` (scheduler hook) caps each row's
         tokens-delivered for this step. With ``ragged=True`` every step —
         speculative or not — is ONE unified mixed dispatch through
-        serving/ragged/ and returns {seq_id: [tokens]}."""
+        serving/ragged/ and returns {seq_id: [tokens]}.
+
+        Degradation (resilience/controller.py): with the ragged path
+        SHED the step falls back to two-phase dispatching — through the
+        speculative path when a proposer is attached (its own shed flag
+        composes), else the plain chunk-then-decode template, which
+        already drives pending chunked admissions via
+        ``_advance_prefill``. Greedy tokens are identical either way;
+        only the dispatch count changes."""
         if self._ragged is not None:
-            return self._ragged.step(seq_ids, token_room)
+            if not self._ragged_shed:
+                return self._ragged.step(seq_ids, token_room)
+            if self._ragged.spec_path is not None:
+                return self._ragged.spec_path.step(seq_ids, token_room)
+            return super().step(seq_ids)   # 1 token/row: room is honored
         if self._spec is not None:
             return self._spec.step(seq_ids, token_room)
         if token_room is not None:
@@ -1503,10 +1521,13 @@ class PagedEngineAdapter(_EngineAdapterBase):
             if not ids and not self._pending_ids():
                 break
             room = {s: remaining.get(s, num_steps) for s in ids}
-            res = path.step(ids, token_room=room)
+            # route through step() so the degradation shed flags apply
+            # here too (a shed plain step returns {seq_id: token})
+            res = self.step(ids, token_room=room)
             if not res and not ids:
                 break                  # pending-only pass made no tokens
             for s, toks in res.items():
+                toks = toks if isinstance(toks, list) else [toks]
                 out.setdefault(s, []).extend(toks)
                 remaining[s] = remaining.get(s, num_steps) - len(toks)
         return out
@@ -1518,6 +1539,41 @@ class PagedEngineAdapter(_EngineAdapterBase):
         standalone speculative path OR the ragged unified path), None
         without speculation — release/preemption must drop per-sequence
         proposer state through exactly one of them."""
+        return self._proposer_of_path()
+
+    @property
+    def speculation_shed(self) -> bool:
+        return self._spec_shed
+
+    @property
+    def ragged_shed(self) -> bool:
+        return self._ragged_shed
+
+    def set_speculation_shed(self, shed: bool) -> None:
+        """Degradation-controller actuator: clamp every draft window to
+        width 1 so steps run the eager-equivalent width-1 verify — no
+        draft dispatches, greedy tokens unchanged. Engaging it drops
+        per-sequence proposer state through the ``_active_proposer``
+        release path (stale draft caches must not survive the gap);
+        Medusa/EAGLE re-seed incrementally on release, exactly like
+        after an eviction. Fully reversible; a no-op without a
+        proposer."""
+        shed = bool(shed)
+        if shed == self._spec_shed:
+            return
+        self._spec_shed = shed
+        proposer = self._active_proposer
+        if shed and proposer is not None and self.seqs:
+            proposer.forget(list(self.seqs))
+
+    def set_ragged_shed(self, shed: bool) -> None:
+        """Degradation-controller actuator: route steps through the
+        two-phase (chunk dispatch + decode/verify dispatch) template
+        instead of the unified ragged dispatch — see :meth:`step`.
+        Reversible; a no-op without ``ragged=True``."""
+        self._ragged_shed = bool(shed)
+
+    def _proposer_of_path(self):
         if self._spec is not None:
             return self._spec.proposer
         if self._ragged is not None:

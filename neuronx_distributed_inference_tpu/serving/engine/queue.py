@@ -99,6 +99,11 @@ class MultiTenantQueue:
         self.default_weight = float(default_weight)
         self.max_depth = max_depth
         self.starvation_bound_s = float(starvation_bound_s)
+        # degradation overlay (resilience/controller.py): a tenant whose
+        # queue-wait SLO is burning gets its EFFECTIVE weight scaled down
+        # without touching the configured weights, so releasing the
+        # action restores the exact original fairness
+        self._weight_scale: Dict[str, float] = {}
         self._heaps: Dict[str, List[Tuple[Tuple[int, int], QueuedRequest]]] \
             = {}
         self._order = itertools.count()
@@ -112,7 +117,23 @@ class MultiTenantQueue:
         return len(self._heaps.get(tenant, ()))
 
     def weight_of(self, tenant: str) -> float:
-        return self.weights.get(tenant, self.default_weight)
+        """The tenant's EFFECTIVE weight: configured (or default) weight
+        times any degradation scale currently applied."""
+        return (self.weights.get(tenant, self.default_weight)
+                * self._weight_scale.get(tenant, 1.0))
+
+    def set_weight_scale(self, tenant: str, scale: float = 1.0) -> None:
+        """Scale a tenant's effective WFQ weight (degradation-controller
+        hook — ``tighten_admission``). ``scale=1.0`` removes the overlay;
+        the starvation bound still protects a scaled-down tenant."""
+        if scale <= 0:
+            raise ConfigurationError(
+                f"weight scale must be > 0 (got {scale}); use a small "
+                "positive scale to deprioritize a tenant")
+        if scale == 1.0:
+            self._weight_scale.pop(tenant, None)
+        else:
+            self._weight_scale[tenant] = float(scale)
 
     def next_order(self) -> int:
         return next(self._order)

@@ -85,7 +85,7 @@ class ServingEngine:
                  decode_steps_per_pass: int = 1,
                  priority_preemption: bool = True,
                  debug_dump_dir: Optional[str] = None,
-                 slo=None):
+                 slo=None, degradation=None):
         for hook in ("take_preempted", "preempt", "prefix_warmth",
                      "free_capacity", "pending_prefill_ids"):
             if not hasattr(adapter, hook):
@@ -107,6 +107,23 @@ class ServingEngine:
         # advisory per-tenant SLO plane (telemetry/slo.py); None = no
         # tracking cost at all (every hook is one attribute check)
         self.slo = slo
+        # closed-loop degradation (resilience/controller.py): consulted
+        # once per pass, acts on the SLO burn index with hysteresis
+        if degradation is not None:
+            if slo is None:
+                raise ConfigurationError(
+                    "degradation= needs slo= — the controller acts on "
+                    "the SLO tracker's burn index (telemetry/slo.py)")
+            if not hasattr(degradation, "update"):
+                raise ConfigurationError(
+                    "degradation= takes a DegradationController "
+                    "(resilience/controller.py) or a compatible "
+                    "update(engine) surface")
+            if hasattr(degradation, "check_policy"):
+                # loud at construction: a defaulted enter threshold that
+                # lands at or below exit_burn would flap per pass
+                degradation.check_policy(slo.policy)
+        self.degradation = degradation
         self._active: Dict[int, QueuedRequest] = {}     # seq_id -> request
         self._sid_of: Dict[str, int] = {}               # request_id -> seq
         self._trace_ids: Dict[str, str] = {}   # request_id -> trace (bounded)
@@ -265,6 +282,10 @@ class ServingEngine:
         adds ``dispatch.*``/``fetch.*`` inside the dispatch slice)."""
         now = time.perf_counter()
         rec = _get_recorder()            # disabled: span() is a no-op CM
+        if self.degradation is not None:
+            # close the loop BEFORE this pass's admission so a tightened
+            # weight/shed applies to the work it is about to schedule
+            self.degradation.update(self, now=now)
         with rec.span("pass.expire", cat="engine"):
             self._expire_queue(now)
         with rec.span("pass.preempt", cat="engine"):
@@ -296,9 +317,32 @@ class ServingEngine:
     async def run_forever(self, idle_sleep_s: float = 0.001) -> None:
         """Asyncio driver: run scheduling passes until :meth:`close`,
         yielding to the event loop between passes (and napping while
-        idle) so SSE writers and new submits interleave."""
+        idle) so SSE writers and new submits interleave.
+
+        An UNEXPECTED exception (not part of the :class:`ServingError`
+        taxonomy — an engine bug, a broken adapter hook) must not kill
+        the loop bare with every client stream left hanging: it is
+        wrapped into an unrecoverable :class:`StepFailure`, the
+        post-mortem is dumped (``debug_dump_dir``) and every stream
+        finishes typed ("error") before the wrapper re-raises — pinned
+        by tests/test_resilience_control.py."""
         while not self._closed:
-            delivered = self.run_pass() if self.has_work else 0
+            try:
+                delivered = self.run_pass() if self.has_work else 0
+            except StepFailure:
+                raise          # _fatal already ran at the raise site
+            except Exception as e:
+                # any OTHER exception escaping a pass — a bare bug or an
+                # unexpected typed error (SequenceStateError & co never
+                # legitimately escape run_pass) — gets the same fatal
+                # teardown: no hanging client streams
+                err = StepFailure(
+                    f"unexpected {type(e).__name__} in the serving loop "
+                    "— engine state was dumped and every stream failed "
+                    "typed; rebuild the engine before serving",
+                    phase="engine", retry_safe=False)
+                self._fatal(err)
+                raise err from e
             if delivered or self.has_work:
                 await asyncio.sleep(0)
             else:
@@ -542,7 +586,18 @@ class ServingEngine:
                 horizon = min(horizon, r)
             eligible.append(sid)
         if not eligible:
-            drained = self.adapter.flush()   # pipelined leftovers
+            try:
+                drained = self.adapter.flush()   # pipelined leftovers
+            except StepFailure as e:
+                # the deferred fetch of an earlier dispatch can fail here
+                # too — same contract as the dispatch below, so the
+                # run_forever invariant ("a StepFailure raise site ran
+                # _fatal first when unrecoverable") holds on this path
+                if e.retry_safe:
+                    self.stats["step_retries"] += 1
+                    return 0
+                self._fatal(e)
+                raise
             return self._route(drained if isinstance(drained, dict) else {})
         try:
             if spec is not None:
@@ -721,6 +776,10 @@ class ServingEngine:
             # read-only SLO plane: per-tenant percentiles, burn rates and
             # the advisory degradation hint (telemetry/slo.py)
             out["slo"] = self.slo.report()
+        if self.degradation is not None:
+            # the closed-loop actuator's hysteresis state
+            # (resilience/controller.py)
+            out["degradation"] = self.degradation.state()
         return out
 
     def dump_debug_state(self, path: Optional[str] = None,

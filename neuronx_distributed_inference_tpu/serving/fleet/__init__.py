@@ -6,10 +6,13 @@ contract):
   * :class:`~.router.EngineRouter` — spreads requests across N
     :class:`~..engine.scheduler.ServingEngine` replicas with
     prefix-affinity routing (warmest ``prefix_warmth``, tie-broken by
-    least queue depth from ``debug_state()``), per-replica
-    healthy/draining/dead states with ``drain()``, and
-    requeue-on-replica-failure riding the ``Preempted`` requeue contract
-    (failover streams stay bit-identical under greedy decoding);
+    least queue depth from ``debug_state()``), a per-replica health
+    state machine (healthy/draining/backing_off/probation/dead —
+    retry-safe step failures quarantine a replica behind exponential
+    backoff with seeded jitter, a clean probing pass re-admits it
+    without operator ``undrain()``), and requeue-on-replica-failure
+    riding the ``Preempted`` requeue contract (failover streams stay
+    bit-identical under greedy decoding);
   * :class:`~.kv_tier.HostKVSpillTier` — a bounded host-RAM tier under
     the device block pool: LRU-evicted prefix blocks spill their
     payloads host-side (content-hash keyed) and re-admit via async H2D
@@ -25,10 +28,12 @@ from .aggregator import FleetMetricsAggregator
 from .handoff import (HANDOFF_SCHEMA, admit_handoff, capture_handoff,
                       handoff_from_json, handoff_to_json)
 from .kv_tier import HostKVSpillTier
-from .router import DEAD, DRAINING, HEALTHY, EngineRouter
+from .router import (BACKING_OFF, DEAD, DRAINING, HEALTHY, PROBATION,
+                     EngineRouter)
 
 __all__ = [
-    "EngineRouter", "HEALTHY", "DRAINING", "DEAD",
+    "EngineRouter", "HEALTHY", "DRAINING", "BACKING_OFF", "PROBATION",
+    "DEAD",
     "HostKVSpillTier", "FleetMetricsAggregator",
     "HANDOFF_SCHEMA", "capture_handoff", "admit_handoff",
     "handoff_to_json", "handoff_from_json",

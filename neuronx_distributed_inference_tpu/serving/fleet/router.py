@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import contextlib
 import itertools
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
@@ -49,15 +50,26 @@ from ...telemetry.trace import get_recorder as _get_recorder
 from ..engine.streams import TokenStream
 from .aggregator import FleetMetricsAggregator
 
-__all__ = ["EngineRouter", "HEALTHY", "DRAINING", "DEAD"]
+__all__ = ["EngineRouter", "HEALTHY", "DRAINING", "BACKING_OFF",
+           "PROBATION", "DEAD"]
 
-#: Replica health states (the README "Fleet" contract):
-#:   healthy  — routable for new admissions
-#:   draining — no new admissions; running AND already-queued work
-#:              finishes normally (``undrain`` returns it to healthy)
-#:   dead     — failed (unrecoverable StepFailure) or closed; its
-#:              in-flight requests were requeued elsewhere
+#: Replica health states (the README "Degradation & chaos" state
+#: machine):
+#:   healthy     — routable for new admissions
+#:   draining    — no new admissions; running AND already-queued work
+#:                 finishes normally (``undrain`` returns it to healthy)
+#:   backing_off — quarantined after retry-safe step failures; not
+#:                 driven and not routable until its
+#:                 exponential-backoff-with-jitter timer expires
+#:   probation   — backoff expired; the next ``run_pass`` is a PROBE —
+#:                 a clean pass re-admits it (healthy), another
+#:                 retry-safe failure escalates the backoff, and
+#:                 ``max_replica_failures`` consecutive failures (or
+#:                 any non-retry-safe failure) escalate to dead
+#:   dead        — failed unrecoverably, retry-exhausted, or closed;
+#:                 its in-flight requests were requeued elsewhere
 HEALTHY, DRAINING, DEAD = "healthy", "draining", "dead"
+BACKING_OFF, PROBATION = "backing_off", "probation"
 
 
 @dataclass
@@ -65,6 +77,12 @@ class _Replica:
     name: str
     engine: Any
     state: str = HEALTHY
+    # retry/backoff bookkeeping (the ReplicaHealth state machine)
+    failures: int = 0              # consecutive retry-safe failures
+    backoff_s: float = 0.0         # current backoff interval (pre-jitter)
+    backoff_until: float = 0.0     # absolute perf_counter() gate
+    quarantines: int = 0
+    was_draining: bool = False     # restore DRAINING after a probe pass
 
 
 @dataclass
@@ -105,7 +123,22 @@ class EngineRouter:
     and ``aggregator`` is None."""
 
     def __init__(self, replicas, *, max_requeues: int = 2,
-                 metrics_registries: Optional[Dict[str, Any]] = None):
+                 metrics_registries: Optional[Dict[str, Any]] = None,
+                 backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
+                 backoff_multiplier: float = 2.0,
+                 backoff_jitter: float = 0.25,
+                 quarantine_after: int = 2,
+                 max_replica_failures: int = 5, seed: int = 0):
+        if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
+            raise ConfigurationError(
+                "backoff_base_s must be > 0 and <= backoff_max_s")
+        if backoff_multiplier < 1.0:
+            raise ConfigurationError("backoff_multiplier must be >= 1")
+        if not 0 <= backoff_jitter < 1:
+            raise ConfigurationError("backoff_jitter must be in [0, 1)")
+        if quarantine_after < 1 or max_replica_failures < 1:
+            raise ConfigurationError(
+                "quarantine_after and max_replica_failures must be >= 1")
         if not isinstance(replicas, dict):
             replicas = {f"r{i}": e for i, e in enumerate(replicas)}
         if not replicas:
@@ -135,10 +168,18 @@ class EngineRouter:
         self._done: List[str] = []     # newest finished ids (bounded)
         self._traces: Dict[str, str] = {}   # request_id -> trace (bounded)
         self._rid_counter = itertools.count()
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_multiplier = backoff_multiplier
+        self.backoff_jitter = backoff_jitter
+        self.quarantine_after = quarantine_after
+        self.max_replica_failures = max_replica_failures
+        self._rng = random.Random(seed)    # seeded jitter: reproducible
         self.stats: Dict[str, int] = {
             "routed": 0, "affinity_warm": 0, "affinity_cold": 0,
             "requeues": 0, "replica_failures": 0, "completed": 0,
-            "drains": 0}
+            "drains": 0, "quarantines": 0, "probes": 0,
+            "probe_readmits": 0}
 
     @contextlib.contextmanager
     def _scoped_registry(self, name: str):
@@ -249,37 +290,86 @@ class EngineRouter:
         return bool(self._requests)
 
     def run_pass(self) -> int:
-        """One fleet pass: drive every live replica's scheduling pass
-        (marking failed/closed ones dead), then pump replica streams into
-        the fleet streams — requeueing any request whose replica died.
-        Returns tokens delivered to fleet streams."""
+        """One fleet pass: drive every live replica's scheduling pass —
+        quarantining replicas whose pass needed retry-safe step retries
+        (the ReplicaHealth state machine: healthy → backing_off →
+        probation → healthy | dead), marking unrecoverably failed or
+        closed ones dead — then pump replica streams into the fleet
+        streams, requeueing any request whose replica died. Returns
+        tokens delivered to fleet streams."""
+        now = time.perf_counter()
         for rep in list(self.replicas.values()):
             if rep.state == DEAD:
                 continue
             if getattr(rep.engine, "closed", False):
                 self._mark_dead(rep, reason="closed")
                 continue
+            if rep.state == BACKING_OFF:
+                if now < rep.backoff_until:
+                    continue           # quarantined: not driven, not routed
+                rep.state = PROBATION
+                self.stats["probes"] += 1
+                self._trace_state(rep, reason="probe")
+            probing = rep.state == PROBATION
+            before = self._step_retries_of(rep)
             try:
                 with self._scoped_registry(rep.name):
                     rep.engine.run_pass()
             except StepFailure as e:
                 if e.retry_safe:
-                    continue           # engine retries next pass
+                    self._quarantine(rep, now)
+                    continue
                 self._mark_dead(rep, reason="step_failure")
+                continue
+            if self._step_retries_of(rep) > before:
+                # the engine absorbed retry-safe step failures this pass
+                # — the replica is flaky; back off before burning more
+                # passes (and, on probation, the probe failed)
+                self._quarantine(rep, now)
+            elif probing:
+                # a clean probing pass re-admits the replica — no
+                # operator undrain() needed
+                rep.state = DRAINING if rep.was_draining else HEALTHY
+                rep.was_draining = False
+                rep.failures = 0
+                rep.backoff_s = 0.0
+                self.stats["probe_readmits"] += 1
+                self._trace_state(rep, reason="probe_readmit")
+            else:
+                rep.failures = 0       # healthy pass resets the streak
         delivered = 0
         for req in list(self._requests.values()):
             delivered += self._pump(req)
         return delivered
 
+    def backoff_wait_s(self) -> float:
+        """The shortest remaining quarantine backoff (capped at
+        ``backoff_max_s``), 0.0 when nothing is backing off — drivers
+        (:meth:`run_until_drained`, the chaos campaign) sleep this out
+        when a pass makes no progress instead of spinning their pass
+        budgets down while the wall clock barely advances."""
+        now = time.perf_counter()
+        waits = [rep.backoff_until - now
+                 for rep in self.replicas.values()
+                 if rep.state == BACKING_OFF]
+        if not waits:
+            return 0.0
+        return min(max(min(waits), 0.0), self.backoff_max_s)
+
     def run_until_drained(self, max_passes: int = 100000) -> None:
         passes = 0
         while self.has_work:
-            self.run_pass()
+            delivered = self.run_pass()
             passes += 1
             if passes >= max_passes:
                 raise ServingError(
                     f"fleet made no progress in {max_passes} passes — "
                     "router wedged (file a bug with the fleet stats)")
+            if delivered:
+                continue
+            wait = self.backoff_wait_s()
+            if wait:
+                time.sleep(wait)
 
     # -- health ------------------------------------------------------------
     def drain(self, name: str) -> None:
@@ -306,18 +396,73 @@ class EngineRouter:
                                      f"{sorted(self.replicas)}")
         return rep
 
+    def _step_retries_of(self, rep: _Replica) -> int:
+        """The replica engine's cumulative retry-safe step-failure count
+        — the health sensor. ``ServingEngine`` absorbs retry-safe
+        :class:`StepFailure`s internally (``stats["step_retries"]``), so
+        the router watches the counter's delta per pass instead of an
+        exception that never propagates. 0 for foreign engine surfaces
+        (they surface failures by raising, handled in :meth:`run_pass`)."""
+        return int(getattr(rep.engine, "stats", {}).get("step_retries", 0))
+
+    def _quarantine(self, rep: _Replica, now: float) -> None:
+        """One retry-safe failure observed: extend the consecutive
+        streak, escalate the exponential backoff (with seeded jitter so
+        N replicas quarantined by one incident do not probe in
+        lockstep), and park the replica in ``backing_off`` — or
+        escalate to dead once the streak exhausts
+        ``max_replica_failures``."""
+        rep.failures += 1
+        if rep.failures >= self.max_replica_failures:
+            self._mark_dead(rep, reason="retry_exhausted")
+            return
+        if (rep.state in (HEALTHY, DRAINING)
+                and rep.failures < self.quarantine_after):
+            return                     # the engine's own retry may heal it
+        if rep.state == DRAINING:
+            rep.was_draining = True
+        rep.backoff_s = (self.backoff_base_s if rep.backoff_s == 0.0
+                         else min(rep.backoff_s * self.backoff_multiplier,
+                                  self.backoff_max_s))
+        jitter = 1.0 + self._rng.uniform(-self.backoff_jitter,
+                                         self.backoff_jitter)
+        rep.backoff_until = now + rep.backoff_s * jitter
+        rep.state = BACKING_OFF
+        rep.quarantines += 1
+        self.stats["quarantines"] += 1
+        self._trace_state(rep, reason="quarantine")
+
     def _mark_dead(self, rep: _Replica, reason: str) -> None:
         if rep.state == DEAD:
             return
         rep.state = DEAD
         self.stats["replica_failures"] += 1
         self._trace_state(rep, reason=reason)
+        if not getattr(rep.engine, "closed", False):
+            # escalated dead with a LIVE engine (retry-exhausted): cancel
+            # its in-flight fleet requests so their device state is
+            # reclaimed — the inner "cancelled" finish from a DEAD
+            # replica is exactly what _pump requeues onto a survivor
+            for req in list(self._requests.values()):
+                if req.replica == rep.name and req.inner is not None \
+                        and not req.inner.finished:
+                    rep.engine.cancel(req.request_id)
+        if not any(r.state == HEALTHY for r in self.replicas.values()):
+            # the operator page: nothing left to route to — surface the
+            # stranded depth instead of letting them learn from a shed
+            rec = _get_recorder()
+            if rec.enabled:
+                rec.instant("fleet.all_dead", cat="fleet",
+                            replica=rep.name, reason=reason,
+                            in_flight=len(self._requests))
 
     def _trace_state(self, rep: _Replica, reason: str) -> None:
         rec = _get_recorder()
         if rec.enabled:
             rec.instant("fleet.drain", cat="fleet", replica=rep.name,
-                        state=rep.state, reason=reason)
+                        state=rep.state, reason=reason,
+                        failures=rep.failures,
+                        backoff_s=round(rep.backoff_s, 4))
 
     # -- routing -----------------------------------------------------------
     def _pick(self, tokens: Sequence[int]):
@@ -348,9 +493,14 @@ class EngineRouter:
             if best is None or key < best[0]:
                 best = (key, name, warmth)
         if best is None:
+            by_state: Dict[str, int] = {}
+            for rep in self.replicas.values():
+                by_state[rep.state] = by_state.get(rep.state, 0) + 1
             raise ReplicaUnavailable(
-                "no healthy replica (all draining or dead) — shed or "
-                "retry elsewhere")
+                "no healthy replica (states: "
+                + ", ".join(f"{k}={v}" for k, v in sorted(by_state.items()))
+                + f"); {len(self._requests)} in-flight request(s) pending "
+                "on this router — shed or retry elsewhere")
         return best[1], best[2]
 
     def _note_route(self, req: _FleetRequest, name: str, warmth: int,
@@ -456,9 +606,15 @@ class EngineRouter:
         ``GET /v1/debug/state`` when the frontend is built with
         ``fleet=``: per-replica health + load, router stats, and the
         in-flight request → replica binding."""
+        now = time.perf_counter()
         replicas = {}
         for name, rep in self.replicas.items():
-            entry: Dict[str, Any] = {"state": rep.state}
+            entry: Dict[str, Any] = {"state": rep.state,
+                                     "failures": rep.failures,
+                                     "quarantines": rep.quarantines}
+            if rep.state == BACKING_OFF:
+                entry["backoff_remaining_s"] = round(
+                    max(rep.backoff_until - now, 0.0), 4)
             if rep.state != DEAD:
                 ds = rep.engine.debug_state()
                 entry.update(queue_depth=ds["queue"]["depth"],

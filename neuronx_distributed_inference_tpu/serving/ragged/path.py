@@ -135,7 +135,10 @@ class RaggedDispatchPath:
             _pre_step_checks(ad.seqs, live, ad._pos_limit, ad.telemetry,
                              horizon=1)
         t0 = time.perf_counter()
-        plan = self.planner.plan(live, seq_ids, token_room, self.max_width)
+        # degradation shed: verify windows clamp to width 1 (decode-kind
+        # rows, no draft dispatch) — greedy tokens unchanged
+        max_width = 1 if ad._spec_shed else self.max_width
+        plan = self.planner.plan(live, seq_ids, token_room, max_width)
         if plan.live_ids:
             self._grow_plan(plan)
             plan.prune(ad)             # rows preempted mid-grow drop out

@@ -136,9 +136,13 @@ class SpeculativeDecodePath:
             return drain()
         t0 = time.perf_counter()
         limit = ad._pos_limit
+        # degradation shed: every window clamps to width 1 — the step
+        # degenerates to the eager-equivalent verify (no draft dispatch,
+        # same greedy tokens); see PagedEngineAdapter.set_speculation_shed
+        max_w = 1 if ad._spec_shed else self.max_width
         widths = {}
         for s in live:
-            w = min(self.max_width, limit - ad.seqs[s].position)
+            w = min(max_w, limit - ad.seqs[s].position)
             if token_room is not None and s in token_room:
                 w = min(w, token_room[s])
             widths[s] = max(1, int(w))

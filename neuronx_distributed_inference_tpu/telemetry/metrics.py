@@ -98,6 +98,10 @@ KV_RESTORE_TOKENS_TOTAL = "nxdi_kv_restore_tokens_total"
 SLO_ATTAINMENT = "nxdi_slo_attainment"               # tenant, signal, window
 SLO_BURN_RATE = "nxdi_slo_burn_rate"                 # tenant, signal, window
 
+# -- degradation controller (resilience/controller.py) -----------------------
+# action: shed_speculation|tighten_admission|drop_ragged
+DEGRADED = "nxdi_degraded"                           # tenant, action
+
 # -- degradations -----------------------------------------------------------
 MOE_TKG_LOCAL_QUANT_DEGRADED_TOTAL = \
     "nxdi_moe_tkg_local_quant_degraded_total"
@@ -486,6 +490,16 @@ def slo_burn_rate_gauge(reg):
         "(1 - objective) — 1.0 means spending budget exactly as fast as "
         "the objective allows",
         labels=("tenant", "signal", "window"))
+
+
+def degraded_gauge(reg):
+    return reg.gauge(
+        DEGRADED,
+        "1 while the degradation controller holds the action active for "
+        "the tenant (hysteresis-guarded; set on degrade.enter, cleared "
+        "on degrade.exit), 0 after exit "
+        "(action=shed_speculation|tighten_admission|drop_ragged)",
+        labels=("tenant", "action"))
 
 
 def moe_tkg_degraded_counter(reg):

@@ -222,6 +222,28 @@ class SLOTracker:
             "hint": self.degradation_hint(now=now),
         }
 
+    def burn_index(self, now: Optional[float] = None
+                   ) -> Dict[Tuple[str, str], float]:
+        """``(tenant, signal) -> min(short burn, long burn)`` for every
+        TARGETED signal with samples — the multiwindow burn number both
+        :meth:`degradation_hint` (against ``policy.burn_threshold``) and
+        the :class:`~...resilience.controller.DegradationController`
+        (against its own hysteresis thresholds) decide on. Taking the
+        MIN of the two windows encodes the classic multiwindow rule:
+        both must burn before anyone acts."""
+        if now is None:
+            now = time.perf_counter()
+        pol = self.policy
+        out: Dict[Tuple[str, str], float] = {}
+        for (tenant, signal), win in sorted(self._windows.items()):
+            target = pol.targets.get(signal)
+            if target is None:
+                continue
+            out[(tenant, signal)] = min(
+                win.violation_fraction(target, w, now) / pol.budget
+                for w in (pol.short_window_s, pol.long_window_s))
+        return out
+
     def degradation_hint(self, now: Optional[float] = None
                          ) -> Dict[str, Any]:
         """Advisory multiwindow burn alerts, per tenant:
@@ -233,24 +255,22 @@ class SLOTracker:
             windows: the engine is admitting more than it can serve
             inside the target.
 
-        Hint-only in this PR: consumers read it from ``/v1/debug/state``
-        (nothing acts on it automatically yet)."""
+        The hint is the threshold-crossed view of :meth:`burn_index`;
+        ``ServingEngine(degradation=...)`` attaches the closed-loop
+        actuator (resilience/controller.py) that actually acts on the
+        same burn numbers with hysteresis — without it the hint stays
+        advisory (``/v1/debug/state``)."""
         if now is None:
             now = time.perf_counter()
         pol = self.policy
         tenants: Dict[str, Any] = {}
-        for (tenant, signal), win in sorted(self._windows.items()):
-            target = pol.targets.get(signal)
-            if target is None:
-                continue
-            burns = [win.violation_fraction(target, w, now) / pol.budget
-                     for w in (pol.short_window_s, pol.long_window_s)]
-            if min(burns) < pol.burn_threshold:
+        for (tenant, signal), burn in self.burn_index(now).items():
+            if burn < pol.burn_threshold:
                 continue
             entry = tenants.setdefault(
                 tenant, {"shed_speculation": False,
                          "tighten_admission": False, "signals": {}})
-            entry["signals"][signal] = round(min(burns), 3)
+            entry["signals"][signal] = round(burn, 3)
             if signal in ("ttft", "tpot"):
                 entry["shed_speculation"] = True
             else:

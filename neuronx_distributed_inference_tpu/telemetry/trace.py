@@ -44,7 +44,7 @@ from .registry import get_registry
 
 __all__ = [
     "ENGINE_PASS_PHASES", "ENGINE_EVENTS", "ADAPTER_EVENTS", "APP_EVENTS",
-    "FLEET_EVENTS", "EVENT_NAMES",
+    "FLEET_EVENTS", "DEGRADE_EVENTS", "EVENT_NAMES",
     "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
     "get_recorder", "set_recorder", "enable_recorder", "disable_recorder",
 ]
@@ -89,8 +89,18 @@ APP_EVENTS = ("run.prefill", "run.decode", "run.decode_loop", "run.paged",
 #:                      admission (seq_id, blocks, tokens)
 #:   ``handoff.send``   a prefill-role engine captured a handoff record
 #:   ``handoff.recv``   a decode-role engine admitted a handoff record
+#:   ``fleet.all_dead`` the LAST healthy replica left rotation — the
+#:                      operator page (replica, reason, in_flight)
 FLEET_EVENTS = ("fleet.route", "fleet.drain", "kv.spill", "kv.restore",
-                "handoff.send", "handoff.recv")
+                "handoff.send", "handoff.recv", "fleet.all_dead")
+
+#: Degradation-controller events (resilience/controller.py). STABLE
+#: names; both carry ``tenant``, ``action`` and the deciding ``burn``.
+#:   ``degrade.enter``  an action engaged (burn crossed the enter
+#:                      threshold in BOTH windows)
+#:   ``degrade.exit``   an action released (burn below the exit
+#:                      threshold after the minimum hold)
+DEGRADE_EVENTS = ("degrade.enter", "degrade.exit")
 
 #: Request-trace lifecycle events (telemetry/request_trace.py +
 #: serving/engine/scheduler.py + serving/fleet/router.py). STABLE names.
@@ -108,7 +118,8 @@ TRACE_EVENTS = ("trace.begin", "trace.admit", "trace.requeue",
                 "trace.emit")
 
 EVENT_NAMES = (ENGINE_PASS_PHASES + ENGINE_EVENTS + ADAPTER_EVENTS
-               + APP_EVENTS + FLEET_EVENTS + TRACE_EVENTS)
+               + APP_EVENTS + FLEET_EVENTS + TRACE_EVENTS
+               + DEGRADE_EVENTS)
 
 #: Category -> Chrome trace tid lane (deterministic ordering in the UI).
 _CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4, "fleet": 5,
