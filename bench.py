@@ -417,6 +417,135 @@ def ragged_overhead_main(artifact_path="artifacts/bench_ragged_r13.json"):
     _emit_report_artifact(payload, artifact_path, "ragged-overhead")
 
 
+PERF_BASELINE_SCHEMA = "nxdi-perf-baseline-v1"
+
+
+def perf_measure():
+    """Measure the tracked serving-path proxy metrics (ISSUE 16's
+    perf-drift gate): the ragged mixed-load structural counts
+    (dispatches / materialized dispatches per engine step, ragged pad
+    waste — the bench_ragged workload in ragged mode), the precompile
+    plane's graph-ladder size and cold-start seconds
+    (serving/warmup.py), and the SPMD golden set's total collective
+    payload bytes. Every gated metric is a deterministic count or ratio
+    on the tiny synthetic model — CPU-runnable, machine-independent;
+    wall-clock style numbers are recorded but marked informational.
+    Returns the flat ``{metric: value}`` dict the snapshot commits and
+    ``scripts/check_perf_drift.py`` re-measures."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.speculation import \
+        SelfDraftProposer
+    from neuronx_distributed_inference_tpu.serving.warmup import precompile
+
+    hf = _tiny_llama_hf()
+    tcfg = TpuConfig(batch_size=4, seq_len=192, dtype="float32",
+                     enable_bucketing=True, enable_2d_bucketing=True,
+                     context_encoding_buckets=[16, 32, 64, 128],
+                     is_block_kv_layout=True, pa_block_size=16,
+                     pa_num_blocks=64, is_prefix_caching=False)
+    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                   LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    # cold-start account FIRST (the graphs must not be warm yet): the
+    # unified ladder's size is structural, its wall seconds are the
+    # cold-start cost this machine paid (informational)
+    warm_rep = precompile(app, chunk_tokens=16, declare_steady=False)
+
+    rng = np.random.default_rng(0)
+    warm = [rng.integers(1, 500, size=n).tolist() for n in (8, 12)]
+    skew = [rng.integers(1, 500, size=n).tolist() for n in (8, 120)]
+    want = 12
+    eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3),
+                             prefill_chunk_tokens=16,
+                             prefill_budget_tokens=16, ragged=True)
+    base = dict(eng.host_stats)
+    got = {s: [] for s in range(4)}
+    steps = 0
+
+    def drive(ids, n):
+        nonlocal steps
+        while any(len(got[s]) < n for s in ids):
+            for s, toks in eng.step().items():
+                toks = toks if isinstance(toks, list) else [toks]
+                got[s].extend(toks)
+            steps += 1
+            assert steps < 400, "mixed workload made no progress"
+
+    eng.add_requests([0, 1], warm)
+    drive((0, 1), 4)
+    eng.add_requests([2, 3], skew)       # mid-decode: mixed load begins
+    drive(range(4), want)
+    stats = {k: eng.host_stats[k] - base.get(k, 0) for k in eng.host_stats}
+    eng.release(range(4))
+    materialized = (stats["blocking_fetches"]
+                    + stats["prefill_blocking_fetches"])
+    with open("artifacts/spmd_golden.json") as f:
+        golden = json.load(f)
+    golden_bytes = sum(c["bytes"] * c["count"]
+                       for g in golden["graphs"].values()
+                       for c in g["collectives"].values())
+    return {
+        "dispatches_per_step": round(
+            (stats["dispatches"] + stats["prefill_dispatches"]) / steps, 3),
+        "materialized_per_step": round(materialized / steps, 3),
+        "ragged_pad_waste": round(
+            1.0 - stats["ragged_real_tokens"]
+            / max(stats["ragged_padded_tokens"], 1), 4),
+        "precompile_graphs": warm_rep["n_graphs"],
+        "precompile_compiles": warm_rep["n_compiles"],
+        "precompile_seconds": round(warm_rep["total_seconds"], 3),
+        "golden_collective_bytes": golden_bytes,
+    }
+
+
+def perf_snapshot_main(artifact_path="artifacts/perf_baseline_r16.json"):
+    """Write the committed perf-drift baseline (ISSUE 16): one
+    ``nxdi-perf-baseline-v1`` artifact holding the tracked proxy metrics
+    from :func:`perf_measure` plus the per-metric drift tolerances the
+    gate enforces. ``scripts/check_perf_drift.py`` re-measures and
+    diffs; the static ``perf-drift`` nxdi-lint pass keeps the committed
+    artifact well-formed and its golden-bytes pin in sync with
+    ``artifacts/spmd_golden.json``. Re-run THIS entry point to
+    re-baseline deliberately (the README section documents the ritual)."""
+    metrics = perf_measure()
+    payload = {
+        "schema": PERF_BASELINE_SCHEMA,
+        "metric": "perf_snapshot_dispatches_per_step",
+        "value": metrics["dispatches_per_step"],
+        "unit": "dispatches_per_engine_step_mixed_load",
+        "metrics": metrics,
+        # symmetric relative tolerances (improvements red too — re-earn
+        # the baseline on purpose, like the SPMD golden); None = recorded
+        # but not gated (machine-dependent wall clock)
+        "tolerances": {
+            "dispatches_per_step": 0.10,
+            "materialized_per_step": 0.10,
+            "ragged_pad_waste": 0.25,
+            "precompile_graphs": 0.0,
+            "precompile_compiles": None,
+            "precompile_seconds": None,
+            "golden_collective_bytes": 0.0,
+        },
+        "details": {
+            "workload": "bench_ragged mixed load (self-draft k=3, "
+                        "skewed 8/120 admit mid-decode), ragged mode",
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "perf-snapshot")
+
+
 def serving_load_main(artifact_path="artifacts/bench_serving_r08.json"):
     """CPU-runnable closed-loop serving-load microbench (ISSUE 6): drives
     the multi-tenant ServingEngine over the paged adapter with a 2x
@@ -1067,6 +1196,8 @@ def main():
         return spec_overhead_main()
     if "--ragged-overhead" in sys.argv[1:]:
         return ragged_overhead_main()
+    if "--perf-snapshot" in sys.argv[1:]:
+        return perf_snapshot_main()
     if "--serving-load" in sys.argv[1:]:
         return serving_load_main()
     if "--fleet-load" in sys.argv[1:]:

@@ -14,6 +14,13 @@ through an ``nxdi_``-prefixed module constant (or literal) and pass
 non-empty help text — so an instrument can never be registered under an
 undocumentable name or with a blank description (rename-red verified by
 ``tests/test_slo_observability.py``).
+
+Extended (ISSUE 16) with the **label contract**: every label a helper
+declares (``labels=("kind", "bucket")``) must appear backticked in the
+README table row documenting that metric — so a label added to an
+instrument (a new dimension on the scrape surface, a stable contract
+like the name itself) cannot ship undocumented, and a documented label
+dropped from the code reads as the stale row it is.
 """
 
 from __future__ import annotations
@@ -130,6 +137,88 @@ def _check_instrument_call(pass_name: str, rel: str, fn: str,
     return findings
 
 
+def label_map(tree: ast.AST,
+              constants: Dict[str, str]) -> Dict[str, List[str]]:
+    """metric name -> declared label names, read from the helpers'
+    instrument calls (``labels=("a", "b")`` keyword of
+    ``reg.counter/gauge/histogram``). Non-literal label expressions are
+    skipped here — the helper contract already flags unresolvable
+    registrations."""
+    out: Dict[str, List[str]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for call in ast.walk(node):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)
+                    and call.func.attr in _INSTRUMENT_KINDS
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "reg"):
+                continue
+            name_arg = call.args[0] if call.args else None
+            if isinstance(name_arg, ast.Constant) and \
+                    isinstance(name_arg.value, str):
+                metric = name_arg.value
+            elif isinstance(name_arg, ast.Name):
+                metric = constants.get(name_arg.id)
+            else:
+                metric = None
+            if metric is None:
+                continue
+            labels = [elt.value
+                      for kw in call.keywords if kw.arg == "labels"
+                      and isinstance(kw.value, (ast.Tuple, ast.List))
+                      for elt in kw.value.elts
+                      if isinstance(elt, ast.Constant)
+                      and isinstance(elt.value, str)]
+            if labels:
+                out.setdefault(metric, [])
+                out[metric].extend(l for l in labels
+                                   if l not in out[metric])
+    return out
+
+
+def documented_rows(readme_source: str) -> Dict[str, List[str]]:
+    """``nxdi_*`` name -> the README Observability table rows mentioning
+    it (a name normally has exactly one row of record)."""
+    lines = readme_source.splitlines()
+    try:
+        start = next(i for i, l in enumerate(lines)
+                     if l.strip() == "## Observability")
+    except StopIteration:
+        return {}
+    rows: Dict[str, List[str]] = {}
+    for line in lines[start + 1:]:
+        if line.startswith("## "):
+            break
+        if line.lstrip().startswith("|"):
+            for nm in _NAME_RE.findall(line):
+                rows.setdefault(nm, []).append(line)
+    return rows
+
+
+def label_findings(pass_name: str, readme_rel: str,
+                   rows: Dict[str, List[str]],
+                   labels_by_name: Dict[str, List[str]]) -> List[Finding]:
+    """The label contract: every declared label of a documented metric
+    must appear backticked in (at least one of) that metric's README
+    table rows."""
+    findings: List[Finding] = []
+    for metric, labels in sorted(labels_by_name.items()):
+        metric_rows = rows.get(metric)
+        if not metric_rows:
+            continue           # undocumented name → the name diff flags it
+        missing = [l for l in labels
+                   if not any(f"`{l}`" in row for row in metric_rows)]
+        for l in missing:
+            findings.append(Finding(
+                pass_name, readme_rel, 1,
+                f"{metric} declares label `{l}` in metrics.py but its "
+                "README Observability row never mentions it — labels are "
+                "scrape-surface contract; document the dimension"))
+    return findings
+
+
 def documented_names(readme_source: str) -> Set[str]:
     """``nxdi_*`` names in the README Observability metric table (table
     rows only — prose mentions elsewhere are cross-references, not
@@ -155,7 +244,8 @@ class MetricNamesPass(Pass):
     description = ("telemetry nxdi_* name constants and the README "
                    "Observability table stay in sync, both directions; "
                    "every metrics.py helper registers an nxdi_-named "
-                   "instrument with non-empty help")
+                   "instrument with non-empty help; declared labels are "
+                   "documented backticked in the metric's README row")
     default_paths = (METRICS_PATH, README_PATH)
 
     def run(self, ctx: LintContext,
@@ -199,4 +289,7 @@ class MetricNamesPass(Pass):
                 self.name, readme_sf.rel, 1,
                 f"{nm} appears in the README Observability table but is "
                 "not registered in metrics.py — typo or leftover row"))
+        findings.extend(label_findings(
+            self.name, readme_sf.rel, documented_rows(readme_sf.text),
+            label_map(metrics_sf.tree, constants)))
         return findings

@@ -1,0 +1,140 @@
+"""perf-drift: the committed perf baseline stays well-formed and its
+cross-artifact pins stay true.
+
+The in-process, no-measure slice of ``scripts/check_perf_drift.py``
+(the live re-measure — a full ragged mixed-load run plus a precompile
+walk — stays in that script; it is tens of seconds of jax work, not a
+sub-second pass): the committed ``artifacts/perf_baseline_r16.json``
+(``bench.py --perf-snapshot``) must carry the expected schema, a
+numeric value for every tracked metric, a tolerance entry for every
+metric and no orphan tolerances (both directions — a metric added to
+the snapshot but never gated, or a tolerance left behind after a
+metric was dropped, is the same silent-ungating class the metric-names
+pass exists for), the serving-structural metrics must actually be
+GATED (a ``None`` tolerance on ``dispatches_per_step`` would turn the
+drift gate into folklore), and the baseline's
+``golden_collective_bytes`` must equal the sum recomputed from the
+committed ``artifacts/spmd_golden.json`` — the two goldens cannot
+drift apart.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..findings import Finding
+from ..registry import LintContext, Pass, register
+
+BASELINE_PATH = "artifacts/perf_baseline_r16.json"
+SPMD_GOLDEN_PATH = "artifacts/spmd_golden.json"
+BASELINE_SCHEMA = "nxdi-perf-baseline-v1"
+
+#: metrics whose tolerance must be a number (gated), never None: the
+#: serving-path structural proxies the drift gate exists to protect.
+MUST_GATE = ("dispatches_per_step", "materialized_per_step",
+             "ragged_pad_waste", "precompile_graphs",
+             "golden_collective_bytes")
+
+
+def golden_bytes_total(golden: Dict[str, Any]) -> int:
+    """Total collective payload (bytes x count over every pinned graph)
+    of an ``nxdi-spmd-golden-v1`` census — the number the baseline pins."""
+    return sum(c["bytes"] * c["count"]
+               for g in golden.get("graphs", {}).values()
+               for c in g.get("collectives", {}).values()
+               if isinstance(c, dict))
+
+
+def validate_baseline(baseline: Any) -> List[Tuple[str, str]]:
+    """Structural findings of one parsed baseline payload as
+    ``(where, message)`` tuples — shared by the registered pass and
+    ``scripts/check_perf_drift.py`` so the two never disagree about
+    well-formedness."""
+    out: List[Tuple[str, str]] = []
+    if not isinstance(baseline, dict):
+        return [("baseline", "payload is not a JSON object")]
+    if baseline.get("schema") != BASELINE_SCHEMA:
+        return [("schema",
+                 f"schema {baseline.get('schema')!r} != "
+                 f"{BASELINE_SCHEMA!r} — re-run bench.py --perf-snapshot")]
+    metrics = baseline.get("metrics")
+    tol = baseline.get("tolerances")
+    if not isinstance(metrics, dict) or not metrics:
+        return [("metrics", "no 'metrics' table — empty baseline")]
+    if not isinstance(tol, dict):
+        return [("tolerances", "no 'tolerances' table — nothing is gated")]
+    for name, v in sorted(metrics.items()):
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            out.append((f"metrics.{name}",
+                        f"value {v!r} is not a number"))
+    for name in sorted(set(metrics) - set(tol)):
+        out.append((f"metrics.{name}",
+                    "tracked metric has no tolerance entry — silently "
+                    "ungated; add it to 'tolerances' (None = "
+                    "informational, on purpose and visible)"))
+    for name in sorted(set(tol) - set(metrics)):
+        out.append((f"tolerances.{name}",
+                    "tolerance for a metric the snapshot no longer "
+                    "measures — stale entry"))
+    for name, t in sorted(tol.items()):
+        if t is None:
+            continue
+        if not isinstance(t, (int, float)) or isinstance(t, bool) or t < 0:
+            out.append((f"tolerances.{name}",
+                        f"tolerance {t!r} is not a non-negative number"))
+    for name in MUST_GATE:
+        if name in metrics and tol.get(name) is None:
+            out.append((f"tolerances.{name}",
+                        "structural serving metric must be gated — a "
+                        "None tolerance here disables the drift gate"))
+    return out
+
+
+@register
+class PerfDriftPass(Pass):
+    name = "perf-drift"
+    description = ("artifacts/perf_baseline_r16.json stays schema-valid, "
+                   "fully gated, and byte-consistent with the SPMD golden")
+    default_paths = (BASELINE_PATH, SPMD_GOLDEN_PATH)
+
+    def run(self, ctx: LintContext,
+            paths: Optional[Sequence[str]] = None) -> List[Finding]:
+        baseline_rel, golden_rel = (paths if paths is not None
+                                    else self.default_paths)
+        base_sf = ctx.source_for(baseline_rel)
+        if base_sf is None:
+            return [Finding(self.name, str(baseline_rel), 0,
+                            "baseline is missing — run bench.py "
+                            "--perf-snapshot to commit one")]
+        try:
+            baseline = json.loads(base_sf.text)
+        except ValueError as e:
+            return [Finding(self.name, base_sf.rel, 1,
+                            f"baseline is not valid JSON: {e}")]
+        findings = [Finding(self.name, base_sf.rel, 1,
+                            f"{where}: {msg}")
+                    for where, msg in validate_baseline(baseline)]
+        if findings:
+            return findings
+        golden_sf = ctx.source_for(golden_rel)
+        if golden_sf is None:
+            return findings + [Finding(
+                self.name, str(golden_rel), 0,
+                "SPMD golden is missing — the baseline's "
+                "golden_collective_bytes pin has nothing to check")]
+        try:
+            golden = json.loads(golden_sf.text)
+        except ValueError as e:
+            return findings + [Finding(self.name, golden_sf.rel, 1,
+                                       f"golden is not valid JSON: {e}")]
+        pinned = baseline["metrics"].get("golden_collective_bytes")
+        actual = golden_bytes_total(golden)
+        if pinned is not None and pinned != actual:
+            findings.append(Finding(
+                self.name, base_sf.rel, 1,
+                f"golden_collective_bytes {pinned} != {actual} summed "
+                "from artifacts/spmd_golden.json — the SPMD golden moved "
+                "without a deliberate re-baseline (bench.py "
+                "--perf-snapshot)"))
+        return findings

@@ -74,6 +74,14 @@ class CausalLMApplication:
         # never feeds the jit cache key itself.
         self._telemetry_override = None
         self._jit_seen: set = set()
+        # cold-start discipline (serving/warmup.py): after precompile()
+        # declares steady state, any first-seen signature is a tracked
+        # incident. _trace_ctx carries the request trace ids of the
+        # dispatch currently executing so the incident is attributed.
+        self._steady_state = False
+        self._steady_incidents: List[Dict[str, Any]] = []
+        self._trace_ctx: Tuple[str, ...] = ()
+        self._warmup_report: Optional[Dict[str, Any]] = None
         self._rng = jax.random.PRNGKey(self.tpu_config.seed)
         self.ctx_buckets = autobucketing.context_encoding_buckets(self.tpu_config)
         self.tkg_buckets = autobucketing.token_generation_buckets(self.tpu_config)
@@ -411,6 +419,8 @@ class CausalLMApplication:
             if rec.enabled:
                 rec.instant("compile", cat="app", kind=kind,
                             bucket=str(bucket), sig=str(sig))
+            if self._steady_state:
+                self._note_steady_recompile(kind, bucket, sig, rec)
         tel = self.telemetry
         if not tel.enabled:
             return
@@ -419,6 +429,68 @@ class CausalLMApplication:
         else:
             tmetrics.jit_compiles_counter(tel).inc(kind=kind,
                                                    bucket=str(bucket))
+
+    # -- steady-state compile discipline (serving/warmup.py) ---------------
+    _MAX_STEADY_INCIDENTS = 256
+
+    def _note_steady_recompile(self, kind: str, bucket, sig, rec) -> None:
+        """A first-seen signature AFTER precompile() declared steady state:
+        a tracked incident — counter, ``compile.unexpected`` flight-
+        recorder event, and attribution onto the request traces packed
+        into the triggering dispatch (``request_context``)."""
+        traces = [t for t in self._trace_ctx if t]
+        incident = {"kind": kind, "bucket": str(bucket), "sig": str(sig),
+                    "traces": traces}
+        self._steady_incidents.append(incident)
+        if len(self._steady_incidents) > self._MAX_STEADY_INCIDENTS:
+            del self._steady_incidents[0]
+        if rec.enabled:
+            rec.instant("compile.unexpected", cat="app", kind=kind,
+                        bucket=str(bucket), sig=str(sig), traces=traces)
+        tel = self.telemetry
+        if tel.enabled:
+            tmetrics.steady_state_recompiles_counter(tel).inc(
+                kind=kind, bucket=str(bucket))
+
+    def declare_steady_state(self, on: bool = True):
+        """Flip the steady-state flag: ``precompile()`` (serving/warmup.py)
+        declares it after walking the serving graph ladder; from then on
+        every first-seen jit signature is a tracked incident."""
+        self._steady_state = bool(on)
+        return self
+
+    def request_context(self, traces):
+        """Context manager attributing any compile observed inside the
+        body to ``traces`` (request trace ids of the dispatch being
+        issued). Adapters wrap their ``_run_*`` calls in steady state."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = self._trace_ctx
+            self._trace_ctx = tuple(traces)
+            try:
+                yield
+            finally:
+                self._trace_ctx = prev
+        return _ctx()
+
+    def warmup_state(self) -> Dict[str, Any]:
+        """JSON-able cold-start account: the precompile report summary,
+        the steady-state flag, and every tracked recompile incident —
+        served as ``/v1/debug/state["warmup"]``."""
+        out: Dict[str, Any] = {
+            "steady_state": self._steady_state,
+            "graphs_seen": len(self._jit_seen),
+            "incidents": list(self._steady_incidents),
+        }
+        if self._warmup_report is not None:
+            out["precompile"] = {
+                k: self._warmup_report[k]
+                for k in ("n_graphs", "n_compiles", "n_cache_loads",
+                          "n_warm_hits", "total_seconds")
+                if k in self._warmup_report}
+        return out
 
     def _next_rng(self):
         self._rng, k = jax.random.split(self._rng)

@@ -700,6 +700,13 @@ class _EngineAdapterBase:
         return _common_tenant(_meta_tenant(self.seqs[s].meta)
                               for s in seq_ids if s in self.seqs)
 
+    def _traces_of(self, seq_ids):
+        """Request trace ids of ``seq_ids`` (running rows) — the
+        attribution payload for steady-state recompile incidents
+        (serving/warmup.py)."""
+        return [_trace_of(self.seqs[s].meta)
+                for s in seq_ids if s in self.seqs]
+
     # -- fetch helpers (the ONLY places that block on device output) -------
     def _fetch_rows(self, out, b: int) -> np.ndarray:
         t0 = time.perf_counter()
@@ -1615,7 +1622,15 @@ class PagedEngineAdapter(_EngineAdapterBase):
         previous dispatch's on-device tokens (pipelined feedback); None =
         host tokens from the scratch buffer."""
         ids = scr.ids if toks_dev is None else toks_dev
-        out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt, scr.last)
+        if self.app._steady_state:
+            # attribute any unexpected recompile to the batched requests'
+            # trace lanes (serving/warmup.py steady-state discipline)
+            with self.app.request_context(self._traces_of(scr.live)):
+                out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
+                                          scr.last)
+        else:
+            out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
+                                      scr.last)
         _async_fetch(out["tokens"])
         self.host_stats["dispatches"] += 1
         self.host_stats["device_steps"] += 1
@@ -1650,7 +1665,11 @@ class PagedEngineAdapter(_EngineAdapterBase):
         try:
             if _FAULTS.active:
                 _FAULTS.fire("decode_step")
-            out = app._run_paged_loop(first, pos, bt, num_steps)
+            if app._steady_state:
+                with app.request_context(self._traces_of(live)):
+                    out = app._run_paged_loop(first, pos, bt, num_steps)
+            else:
+                out = app._run_paged_loop(first, pos, bt, num_steps)
             self.host_stats["dispatches"] += 1
             self.host_stats["device_steps"] += num_steps
             rec = _get_recorder()

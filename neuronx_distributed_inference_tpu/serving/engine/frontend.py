@@ -40,8 +40,16 @@ engine's submit / stream / cancel / metrics surface:
   ``GET /v1/debug/trace/<id>``
       ONE request's trace (``<id>`` = request id or trace id): the
       events carrying its ``trace_id`` — queue, admission, dispatch
-      rows, requeues, emission — as Chrome trace JSON; 404 when nothing
-      matches (unknown id / recorder disabled).
+      rows, requeues, emission — as Chrome trace JSON; 404 with a typed
+      JSON body (``"type": "trace_not_found"``) when nothing matches
+      (unknown id / recorder disabled).
+  ``GET /v1/debug/memory``
+      The live HBM ledger (schema ``nxdi-memory-ledger-v1``,
+      serving/warmup.py): model parameter bytes, the KV pool split by
+      block state (reconciling exactly with the adapter's block
+      accounting), spill-tier residency, fragmentation ratio and the
+      admission-headroom estimate — per-replica under ``"fleet"`` when
+      a router is attached.
 
 Client-gone behaviour: when an SSE write fails (peer reset / closed), the
 front end cancels the request through the engine — blocks are reclaimed
@@ -70,15 +78,29 @@ _MAX_BODY = 1 << 20                      # 1 MiB request-body cap
 
 
 class _HttpError(Exception):
-    def __init__(self, status: int, message: str):
+    """Typed HTTP failure: every error response body is
+    ``{"error": <message>, "type": <stable machine tag>, "status": n}``
+    so clients can dispatch on ``type`` instead of parsing prose.
+    ``type_`` defaults to the status's generic tag (``not_found``,
+    ``bad_request``, ...); raisers pass a more specific one when they
+    have it (e.g. ``trace_not_found``)."""
+
+    def __init__(self, status: int, message: str,
+                 type_: Optional[str] = None):
         super().__init__(message)
         self.status = status
+        self.type = type_ or _STATUS_TYPE.get(status, "error")
 
 
 _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
                 405: "Method Not Allowed", 413: "Payload Too Large",
                 429: "Too Many Requests", 500: "Internal Server Error",
                 503: "Service Unavailable"}
+
+_STATUS_TYPE = {400: "bad_request", 404: "not_found",
+                405: "method_not_allowed", 413: "payload_too_large",
+                429: "queue_overflow", 500: "internal_error",
+                503: "unavailable"}
 
 
 class ServingFrontend:
@@ -135,7 +157,8 @@ class ServingFrontend:
                 await self._route(method, path, body, writer)
             except _HttpError as e:
                 await self._send_json(writer, e.status,
-                                      {"error": str(e)})
+                                      {"error": str(e), "type": e.type,
+                                       "status": e.status})
         except (ConnectionError, asyncio.IncompleteReadError):
             pass                      # client went away mid-exchange
         finally:
@@ -188,6 +211,11 @@ class ServingFrontend:
             # live post-mortem: engine/adapter snapshot + flight-recorder
             # tail (events empty while the recorder is disabled)
             await self._send_json(writer, 200, self._debug_payload())
+        elif path == "/v1/debug/memory" and method == "GET":
+            # live HBM ledger (serving/warmup.py): model bytes, KV pool
+            # by block state, spill residency, fragmentation, headroom —
+            # plus the per-replica fleet account with a router attached
+            await self._send_json(writer, 200, self._memory_payload())
         elif path.startswith("/v1/debug/trace/") and method == "GET":
             # per-request trace: <id> is a request id (resolved through
             # the engine/router trace maps) or a raw trace id; returns
@@ -287,9 +315,26 @@ class ServingFrontend:
         events = trace_events(rec.events(), tid)
         if not events:
             raise _HttpError(404, f"no trace events for {key!r} (unknown "
-                                  "id, aged out, or recorder disabled)")
+                                  "id, aged out, or recorder disabled)",
+                             type_="trace_not_found")
         payload = rec.to_chrome(events)
         payload["otherData"]["trace_id"] = tid
+        return payload
+
+    def _memory_payload(self) -> Dict[str, Any]:
+        """The ``GET /v1/debug/memory`` body: this engine's HBM ledger
+        (reconciling exactly with the adapter's block accounting), with
+        the gauges refreshed into the scrape registry at read time; a
+        fleet router contributes its per-replica ledgers under
+        ``"fleet"``."""
+        from ..warmup import memory_ledger
+        reg_of = getattr(self.fleet, "registry_of", lambda _e: None) \
+            if self.fleet is not None else (lambda _e: None)
+        payload = memory_ledger(self.engine.adapter,
+                                registry=reg_of(self.engine)
+                                or get_registry())
+        if self.fleet is not None and hasattr(self.fleet, "memory_report"):
+            payload["fleet"] = self.fleet.memory_report()
         return payload
 
     def _debug_payload(self) -> Dict[str, Any]:

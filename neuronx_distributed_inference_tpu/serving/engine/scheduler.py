@@ -517,6 +517,8 @@ class ServingEngine:
             for r in reversed(batch):
                 self.queue.push(r, front=True)
             self.stats["admission_retries"] += 1
+            if isinstance(e, CapacityError):
+                self._note_headroom("admit")
             return
         for sid, tok in first.items():   # non-deferred adapters
             self._deliver(sid, [tok])
@@ -615,6 +617,7 @@ class ServingEngine:
                 self._finish_capacity(e.seq_ids)
             else:
                 self.stats["capacity_stalls"] += 1
+            self._note_headroom("step")
             return 0
         except StepFailure as e:
             if e.retry_safe:
@@ -746,6 +749,24 @@ class ServingEngine:
             req.stream.finish("error", err)
             self._finalize(req)
 
+    def _note_headroom(self, where: str) -> None:
+        """Flight-record the admission-headroom estimate at the moment a
+        capacity reject happens — free batch slots, free KV blocks and
+        the token headroom they represent (serving/warmup.py
+        ``admission_headroom``), so post-mortems can tell a full pool
+        from a fragmented one."""
+        rec = _get_recorder()
+        if not rec.enabled:
+            return
+        try:
+            from ..warmup import admission_headroom
+            rec.instant("admission.headroom", cat="engine", where=where,
+                        **admission_headroom(self.adapter))
+        except Exception:
+            # best-effort observability: a broken estimate must never
+            # turn a capacity stall into an engine fault
+            pass
+
     # -- post-mortem surface ----------------------------------------------
     def debug_state(self) -> Dict[str, Any]:
         """Read-only JSON-able snapshot of the scheduler + adapter:
@@ -772,6 +793,11 @@ class ServingEngine:
             "reserved": list(self._reserved),
             "adapter": adapter,
         }
+        app = getattr(self.adapter, "app", None)
+        if app is not None and hasattr(app, "warmup_state"):
+            # cold-start discipline (serving/warmup.py): the precompile
+            # report summary plus every steady-state recompile incident
+            out["warmup"] = app.warmup_state()
         if self.slo is not None:
             # read-only SLO plane: per-tenant percentiles, burn rates and
             # the advisory degradation hint (telemetry/slo.py)

@@ -273,6 +273,30 @@ class EngineRouter:
                 return self._registries[name]
         return None
 
+    def memory_report(self) -> Dict[str, Any]:
+        """Per-replica HBM ledgers (serving/warmup.py
+        :func:`~..warmup.memory_ledger`), keyed by replica name — the
+        fleet section of ``GET /v1/debug/memory``. Each ledger's gauges
+        are refreshed into that replica's scoped registry (when
+        ``metrics_registries`` is set), so the fleet-aggregated scrape
+        carries ``nxdi_hbm_*{replica=...}`` series. Dead replicas and
+        ledger failures report ``{"error": ...}`` instead of sinking
+        the endpoint."""
+        out: Dict[str, Any] = {}
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if rep.state == DEAD:
+                out[name] = {"error": "replica dead"}
+                continue
+            try:
+                from ..warmup import memory_ledger
+                reg = (self._registries[name]
+                       if self._registries is not None else None)
+                out[name] = memory_ledger(rep.engine.adapter, registry=reg)
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
     def cancel(self, request_id: str) -> bool:
         """Cancel wherever the request currently lives; returns False for
         unknown/finished ids."""

@@ -455,8 +455,18 @@ class RaggedDispatchPath:
         materializing any output; the async copies are started so the
         fetch one call later is cheap."""
         ad = self.adapter
-        out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid, emit,
-                                 want_hidden=self.wants_hidden)
+        if ad.app._steady_state:
+            # steady-state compile discipline (serving/warmup.py): carry
+            # the packed rows' request trace ids so an unexpected
+            # recompile is attributed to its victims' trace lanes
+            with ad.app.request_context(
+                    self._row_trace(r.seq_id) for r in rows):
+                out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid,
+                                         emit,
+                                         want_hidden=self.wants_hidden)
+        else:
+            out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid, emit,
+                                     want_hidden=self.wants_hidden)
         _async_fetch(out["tokens"])
         _async_fetch(out["num_emitted"])
         ad.host_stats["dispatches"] += 1

@@ -227,7 +227,13 @@ class SpeculativeDecodePath:
         try:
             if _FAULTS.active:
                 _FAULTS.fire("spec_draft")
-            drafts = (self.proposer.propose(ctx) if W > 1 else None)
+            if app._steady_state:
+                # a draft-pass compile in steady state is an incident like
+                # any other: attribute it to the live rows' request traces
+                with app.request_context(ad._traces_of(live)):
+                    drafts = (self.proposer.propose(ctx) if W > 1 else None)
+            else:
+                drafts = (self.proposer.propose(ctx) if W > 1 else None)
         except ServingError as e:
             rollback()
             _trace_error(e)

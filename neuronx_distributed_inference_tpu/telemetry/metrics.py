@@ -66,6 +66,17 @@ JIT_COMPILES_TOTAL = "nxdi_jit_compiles_total"        # kind, bucket
 JIT_CACHE_HITS_TOTAL = "nxdi_jit_cache_hits_total"    # kind
 BUCKET_SELECTED_TOTAL = "nxdi_bucket_selected_total"  # kind, bucket
 
+# -- cold-start / steady-state compile discipline (serving/warmup.py) --------
+STEADY_STATE_RECOMPILES_TOTAL = \
+    "nxdi_steady_state_recompiles_total"              # kind, bucket
+
+# -- HBM ledger (serving/warmup.py memory_ledger) ----------------------------
+# state: used|free|unwritten|spilled (spilled = host-RAM tier residency,
+# reported in the same account so the device + spill total is one read)
+HBM_MODEL_BYTES = "nxdi_hbm_model_bytes"
+HBM_KV_BYTES = "nxdi_hbm_kv_bytes"                    # state
+KV_FRAGMENTATION_RATIO = "nxdi_kv_fragmentation_ratio"
+
 # -- paged KV cache (modules/block_kv_cache.py) ------------------------------
 KV_BLOCKS_TOTAL = "nxdi_kv_blocks_total"
 KV_BLOCKS_IN_USE = "nxdi_kv_blocks_in_use"
@@ -343,6 +354,37 @@ def bucket_selected_counter(reg):
     return reg.counter(BUCKET_SELECTED_TOTAL,
                        "Host-side pad-target bucket selections",
                        labels=("kind", "bucket"))
+
+
+def steady_state_recompiles_counter(reg):
+    return reg.counter(
+        STEADY_STATE_RECOMPILES_TOTAL,
+        "Graph builds observed AFTER precompile() declared steady state — "
+        "every one is a tracked incident (compile.unexpected on the "
+        "flight recorder, attributed to the triggering request traces)",
+        labels=("kind", "bucket"))
+
+
+def hbm_model_bytes_gauge(reg):
+    return reg.gauge(
+        HBM_MODEL_BYTES,
+        "Bytes held by the replica's model parameters (exact pytree "
+        "leaf-byte sum — the static side of the HBM ledger)")
+
+
+def hbm_kv_bytes_gauge(reg):
+    return reg.gauge(
+        HBM_KV_BYTES,
+        "KV pool bytes by ledger state (used|free|unwritten device "
+        "blocks; spilled = host-RAM tier residency in the same account)",
+        labels=("state",))
+
+
+def kv_fragmentation_ratio_gauge(reg):
+    return reg.gauge(
+        KV_FRAGMENTATION_RATIO,
+        "Wasted slot fraction inside allocated KV blocks: 1 - live "
+        "tokens / (blocks_in_use * block_size); 0 with nothing allocated")
 
 
 def kv_blocks_total_gauge(reg):

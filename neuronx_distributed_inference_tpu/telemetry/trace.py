@@ -44,7 +44,7 @@ from .registry import get_registry
 
 __all__ = [
     "ENGINE_PASS_PHASES", "ENGINE_EVENTS", "ADAPTER_EVENTS", "APP_EVENTS",
-    "FLEET_EVENTS", "DEGRADE_EVENTS", "EVENT_NAMES",
+    "FLEET_EVENTS", "DEGRADE_EVENTS", "WARMUP_EVENTS", "EVENT_NAMES",
     "FlightRecorder", "NullFlightRecorder", "NULL_RECORDER",
     "get_recorder", "set_recorder", "enable_recorder", "disable_recorder",
 ]
@@ -56,7 +56,11 @@ ENGINE_PASS_PHASES = ("pass.expire", "pass.preempt", "pass.admit",
 
 #: Other engine-lane events (serving/engine/scheduler.py). STABLE names.
 #:   ``stream.deliver``         tokens routed to request streams
-ENGINE_EVENTS = ("stream.deliver",)
+#:   ``admission.headroom``     the scheduler hit a capacity reject/stall;
+#:                              carries the adapter's live admission-
+#:                              headroom estimate (free_blocks,
+#:                              headroom_tokens, free_slots)
+ENGINE_EVENTS = ("stream.deliver", "admission.headroom")
 
 #: Adapter boundary events (serving/adapter.py + serving/ragged/path.py).
 #: STABLE names.
@@ -117,9 +121,19 @@ DEGRADE_EVENTS = ("degrade.enter", "degrade.exit")
 TRACE_EVENTS = ("trace.begin", "trace.admit", "trace.requeue",
                 "trace.emit")
 
+#: Cold-start / steady-state compile events (serving/warmup.py +
+#: models/application.py). STABLE names.
+#:   ``compile.unexpected``  a graph build AFTER precompile() declared
+#:                           steady state — a tracked incident (kind,
+#:                           bucket, sig, plus ``traces`` = the request
+#:                           trace ids packed into the triggering
+#:                           dispatch, so the incident lands on the
+#:                           victims' trace lanes)
+WARMUP_EVENTS = ("compile.unexpected",)
+
 EVENT_NAMES = (ENGINE_PASS_PHASES + ENGINE_EVENTS + ADAPTER_EVENTS
                + APP_EVENTS + FLEET_EVENTS + TRACE_EVENTS
-               + DEGRADE_EVENTS)
+               + DEGRADE_EVENTS + WARMUP_EVENTS)
 
 #: Category -> Chrome trace tid lane (deterministic ordering in the UI).
 _CAT_TIDS = {"engine": 1, "adapter": 2, "app": 3, "error": 4, "fleet": 5,

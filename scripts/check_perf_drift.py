@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Perf-drift gate over the committed baseline (ISSUE 16).
+
+Two layers, split by cost:
+
+  1. **Static** (sub-second, runs in tier-1 through ``nxdi_lint``'s
+     ``perf-drift`` pass): the committed
+     ``artifacts/perf_baseline_r16.json`` is schema-valid, every tracked
+     metric is gated (or marked informational on purpose), and its
+     ``golden_collective_bytes`` pin matches the SPMD golden. This
+     script runs that layer first, always.
+  2. **Live** (tens of seconds of jax work, opt-in): re-measure the
+     tracked proxies with ``bench.perf_measure()`` — the ragged
+     mixed-load structural counts plus the precompile ladder — and diff
+     against the baseline with :func:`compare`. Symmetric: an
+     improvement past tolerance is red too (re-earn the baseline with
+     ``python bench.py --perf-snapshot``, deliberately, in its own
+     commit — the README "Cold start, memory & drift" section has the
+     ritual).
+
+Usage::
+
+    python scripts/check_perf_drift.py             # static + live measure
+    python scripts/check_perf_drift.py --static    # artifact checks only
+    python scripts/check_perf_drift.py --current F # diff a saved
+        {metric: value} JSON against the baseline without measuring
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+from typing import Dict, List
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT / "scripts"))
+
+from nxdi_lint import load_analysis  # noqa: E402
+
+BASELINE = REPO_ROOT / "artifacts" / "perf_baseline_r16.json"
+
+
+def compare(baseline: Dict, current: Dict[str, float]) -> List[str]:
+    """Pure drift diff: one message per gated metric outside its
+    symmetric relative tolerance (or missing from ``current``).
+    ``baseline`` is the full snapshot payload; ``current`` a flat
+    ``{metric: value}`` dict (``bench.perf_measure()``'s shape)."""
+    out: List[str] = []
+    metrics = baseline.get("metrics", {})
+    tolerances = baseline.get("tolerances", {})
+    for name in sorted(metrics):
+        tol = tolerances.get(name)
+        if tol is None:
+            continue                      # informational, on purpose
+        if name not in current:
+            out.append(f"{name}: missing from the current measurement")
+            continue
+        base, cur = metrics[name], current[name]
+        if base == 0:
+            drifted, desc = cur != 0, f"{cur} vs baseline 0"
+        else:
+            rel = abs(cur - base) / abs(base)
+            drifted = rel > tol
+            desc = (f"{cur} vs baseline {base} "
+                    f"({rel:+.1%} > ±{tol:.0%} tolerance)")
+        if drifted:
+            out.append(
+                f"{name}: {desc} — a real regression, or a deliberate "
+                "change that must re-earn the baseline "
+                "(python bench.py --perf-snapshot)")
+    return out
+
+
+def main(argv=()) -> int:
+    argv = [str(a) for a in argv]
+    analysis = load_analysis()
+    p = analysis.get_pass("perf-drift")
+    findings = p.run(analysis.LintContext(REPO_ROOT))
+    for f in findings:
+        print(f"check_perf_drift: {f.message}", file=sys.stderr)
+    if findings:
+        return 1
+    if "--static" in argv:
+        print("check_perf_drift: OK (static; baseline well-formed)")
+        return 0
+    baseline = json.loads(BASELINE.read_text())
+    if "--current" in argv:
+        current = json.loads(
+            Path(argv[argv.index("--current") + 1]).read_text())
+    else:
+        sys.path.insert(0, str(REPO_ROOT))
+        import bench
+        current = bench.perf_measure()
+    drift = compare(baseline, current)
+    for msg in drift:
+        print(f"check_perf_drift: {msg}", file=sys.stderr)
+    if drift:
+        return 1
+    gated = sum(1 for t in baseline.get("tolerances", {}).values()
+                if t is not None)
+    print(f"check_perf_drift: OK ({gated} gated metrics within tolerance)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

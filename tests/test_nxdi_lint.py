@@ -31,8 +31,8 @@ nxdi_lint = load_nxdi_lint()
 analysis = nxdi_lint.load_analysis()
 
 ALL_PASSES = ("aliasing-safety", "donation-safety", "error-paths",
-              "host-sync", "metric-names", "recompile-hazard",
-              "spmd-golden")
+              "host-sync", "metric-names", "perf-drift",
+              "recompile-hazard", "spmd-golden")
 
 
 @pytest.fixture(scope="module")
@@ -290,6 +290,124 @@ def test_spmd_golden_drift_red_both_ways(tmp_path):
     msgs = "\n".join(f.message for f in findings)
     assert dropped in msgs and "no golden census" in msgs
     assert "ghost_graph_dp9" in msgs and "stale" in msgs
+
+
+# ---------------------------------------------------------------------------
+# perf-drift: committed baseline green; doctored baselines red (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _fake_baseline_repo(tmp_path, baseline):
+    (tmp_path / "artifacts").mkdir(exist_ok=True)
+    (tmp_path / "artifacts" / "perf_baseline_r16.json").write_text(
+        json.dumps(baseline))
+    shutil.copy(REPO / "artifacts" / "spmd_golden.json",
+                tmp_path / "artifacts" / "spmd_golden.json")
+    return tmp_path
+
+
+@pytest.fixture(scope="module")
+def committed_baseline():
+    return json.loads(
+        (REPO / "artifacts" / "perf_baseline_r16.json").read_text())
+
+
+def test_perf_drift_green_on_committed_baseline(live_report):
+    # the committed artifact passes the registered pass (part of the
+    # --all green assertion too, but pin it by name)
+    findings = analysis.get_pass("perf-drift").run(
+        analysis.LintContext(REPO))
+    assert [f.message for f in findings] == []
+
+
+def test_perf_drift_red_on_ungated_and_stale_tolerances(
+        tmp_path, committed_baseline):
+    doctored = json.loads(json.dumps(committed_baseline))
+    doctored["tolerances"]["dispatches_per_step"] = None   # ungate
+    doctored["tolerances"]["ghost_metric"] = 0.1           # stale entry
+    del doctored["tolerances"]["ragged_pad_waste"]         # silently ungated
+    root = _fake_baseline_repo(tmp_path, doctored)
+    msgs = "\n".join(f.message for f in analysis.get_pass(
+        "perf-drift").run(analysis.LintContext(root)))
+    assert "dispatches_per_step" in msgs and "must be gated" in msgs
+    assert "ghost_metric" in msgs and "stale" in msgs
+    assert "ragged_pad_waste" in msgs and "no tolerance" in msgs
+
+
+def test_perf_drift_red_on_golden_bytes_divergence(
+        tmp_path, committed_baseline):
+    doctored = json.loads(json.dumps(committed_baseline))
+    doctored["metrics"]["golden_collective_bytes"] += 1
+    root = _fake_baseline_repo(tmp_path, doctored)
+    msgs = "\n".join(f.message for f in analysis.get_pass(
+        "perf-drift").run(analysis.LintContext(root)))
+    assert "golden_collective_bytes" in msgs and "spmd_golden" in msgs
+
+
+def test_perf_drift_compare_green_then_red_on_injected_regression(
+        committed_baseline):
+    """The acceptance pin: the gate is green against the committed
+    baseline's own values and red under an injected dispatches/step
+    regression — via the check script's pure compare()."""
+    cpd = _load_script("check_perf_drift")
+    assert cpd.compare(committed_baseline,
+                       dict(committed_baseline["metrics"])) == []
+    hurt = dict(committed_baseline["metrics"])
+    hurt["dispatches_per_step"] = round(
+        hurt["dispatches_per_step"] * 1.5, 3)
+    msgs = cpd.compare(committed_baseline, hurt)
+    assert len(msgs) == 1 and "dispatches_per_step" in msgs[0]
+    # informational (None-tolerance) metrics never gate
+    slow = dict(committed_baseline["metrics"])
+    slow["precompile_seconds"] = slow["precompile_seconds"] * 100
+    assert cpd.compare(committed_baseline, slow) == []
+    # a gated metric missing from the measurement is a failure, not a skip
+    gone = dict(committed_baseline["metrics"])
+    del gone["ragged_pad_waste"]
+    assert any("ragged_pad_waste" in m and "missing" in m
+               for m in cpd.compare(committed_baseline, gone))
+
+
+def test_perf_drift_script_static_entry(capsys):
+    cpd = _load_script("check_perf_drift")
+    assert cpd.main(["--static"]) == 0
+    assert "OK" in capsys.readouterr().out
+
+
+def test_perf_drift_script_current_diff(tmp_path, capsys,
+                                        committed_baseline):
+    cpd = _load_script("check_perf_drift")
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps(dict(committed_baseline["metrics"])))
+    assert cpd.main(["--current", str(cur)]) == 0
+    hurt = dict(committed_baseline["metrics"])
+    hurt["materialized_per_step"] *= 2
+    cur.write_text(json.dumps(hurt))
+    assert cpd.main(["--current", str(cur)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# metric-names label contract: rename-red (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def test_label_contract_red_on_undocumented_label(tmp_path):
+    """Rename-red for the label contract: strip one backticked label
+    from the REAL README row of a labeled metric — the pass must name
+    both the metric and the missing label."""
+    metrics_src = (PKG / "telemetry" / "metrics.py").read_text()
+    readme = (REPO / "README.md").read_text()
+    assert "| `nxdi_hbm_kv_bytes` | gauge | `state`" in readme
+    doctored = readme.replace(
+        "| `nxdi_hbm_kv_bytes` | gauge | `state`",
+        "| `nxdi_hbm_kv_bytes` | gauge | state")   # un-backtick the label
+    (tmp_path / "metrics.py").write_text(metrics_src)
+    (tmp_path / "README.md").write_text(doctored)
+    findings = analysis.get_pass("metric-names").run(
+        analysis.LintContext(tmp_path),
+        paths=(str(tmp_path / "metrics.py"), str(tmp_path / "README.md")))
+    msgs = [f.message for f in findings]
+    assert any("nxdi_hbm_kv_bytes" in m and "`state`" in m for m in msgs)
+    # and ONLY the doctored label — the live tree's rows all conform
+    assert all("nxdi_hbm_kv_bytes" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------
