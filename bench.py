@@ -494,6 +494,7 @@ def perf_measure():
     golden_bytes = sum(c["bytes"] * c["count"]
                        for g in golden["graphs"].values()
                        for c in g["collectives"].values())
+    migrations_per_drain, avoided = _measure_migration_proxies()
     return {
         "dispatches_per_step": round(
             (stats["dispatches"] + stats["prefill_dispatches"]) / steps, 3),
@@ -505,7 +506,64 @@ def perf_measure():
         "precompile_compiles": warm_rep["n_compiles"],
         "precompile_seconds": round(warm_rep["total_seconds"], 3),
         "golden_collective_bytes": golden_bytes,
+        "migrations_per_drain": migrations_per_drain,
+        "recompute_avoided_tokens": avoided,
     }
+
+
+def _measure_migration_proxies():
+    """Deterministic drain-by-migration mini-scenario (ISSUE 17's
+    structural autoscale proxies): two spill-tier replicas, two
+    mid-decode streams pinned onto one of them, then
+    ``drain(mode="migrate")`` moves both. Returns
+    ``(migrations per migrate-mode drain, KV tokens moved instead of
+    recomputed)`` — both exact counts on the tiny model (every migrated
+    fully-written block is block_size tokens the destination did NOT
+    recompute-prefill), gated at 0.0 tolerance."""
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
+    from neuronx_distributed_inference_tpu.serving.fleet import (
+        EngineRouter, HostKVSpillTier)
+
+    hf = _tiny_llama_hf()
+
+    def make_engine():
+        tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
+                         enable_bucketing=True,
+                         context_encoding_buckets=[16],
+                         is_block_kv_layout=True, pa_block_size=8,
+                         is_prefix_caching=True)
+        app = PagedCausalLMApplication(None,
+                                       LlamaInferenceConfig(tcfg, **hf),
+                                       LlamaFamily)
+        app.init_random_weights(seed=0).init_cache()
+        adapter = PagedEngineAdapter(
+            app, kv_spill_tier=HostKVSpillTier(max_blocks=16))
+        return ServingEngine(adapter, starvation_bound_s=1e9)
+
+    router = EngineRouter({"r0": make_engine(), "r1": make_engine()})
+    router.drain("r1")                   # pin both streams onto r0
+    rng = np.random.default_rng(3)
+    streams = [router.submit(rng.integers(1, 500, size=9).tolist(), 8)
+               for _ in range(2)]
+    router.undrain("r1")
+    for _ in range(200):
+        if all(s.n_tokens >= 5 for s in streams):
+            break
+        router.run_pass()
+    moved = router.drain("r0", mode="migrate")
+    router.run_until_drained()
+    assert moved == 2 and all(s.finish_reason == "length" for s in streams)
+    for rep in router.replicas.values():
+        rep.engine.close()
+    return (round(router.stats["migrations"]
+                  / router.stats["migrate_drains"], 3),
+            router.stats["migrated_kv_tokens"])
 
 
 def perf_snapshot_main(artifact_path="artifacts/perf_baseline_r16.json"):
@@ -535,6 +593,8 @@ def perf_snapshot_main(artifact_path="artifacts/perf_baseline_r16.json"):
             "precompile_compiles": None,
             "precompile_seconds": None,
             "golden_collective_bytes": 0.0,
+            "migrations_per_drain": 0.0,
+            "recompute_avoided_tokens": 0.0,
         },
         "details": {
             "workload": "bench_ragged mixed load (self-draft k=3, "
@@ -820,6 +880,170 @@ def fleet_load_main(artifact_path="artifacts/bench_fleet_r11.json"):
         },
     }
     _emit_report_artifact(payload, artifact_path, "fleet-load")
+
+
+def autoscale_report_main(
+        artifact_path="artifacts/bench_autoscale_r17.json"):
+    """CPU-runnable closed-loop autoscaler report (ISSUE 17): replay a
+    seeded diurnal-ramp workload (serving/fleet/loadgen.py) against an
+    elastic fleet on a VIRTUAL clock — the FleetAutoscaler (attached to
+    the EngineRouter, consulted once per pass) must scale up on the
+    ramp's front slope with a replica that PRECOMPILED to zero compiles
+    against the shared persistent compilation cache, and scale back
+    down on the far slope by drain-by-migration (running streams move
+    with their KV). Reports the scale timeline, migrated-stream count
+    and virtual-clock TTFT/TPOT p50/p99; asserts >= 1 scale-up, >= 1
+    scale-down, hysteresis (opposite actions separated by >= the
+    cooldown) and n_compiles == 0 on every admitted replica. One
+    parseable JSON line + an artifact file; no TPU required."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+    import tempfile
+    cache_dir = tempfile.mkdtemp(prefix="nxdi-autoscale-cache-")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+    except Exception:
+        pass  # flags already pinned by an embedding test runner
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    from neuronx_distributed_inference_tpu.serving.engine import ServingEngine
+    from neuronx_distributed_inference_tpu.serving.fleet import (
+        EngineRouter, FleetAutoscaler, HostKVSpillTier, diurnal_ramp)
+    from neuronx_distributed_inference_tpu.serving.warmup import precompile
+
+    hf = _tiny_llama_hf()
+    max_new = 6
+
+    def make_app():
+        tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
+                         enable_bucketing=True,
+                         context_encoding_buckets=[16],
+                         is_block_kv_layout=True, pa_block_size=8,
+                         is_prefix_caching=True)
+        app = PagedCausalLMApplication(None,
+                                       LlamaInferenceConfig(tcfg, **hf),
+                                       LlamaFamily)
+        app.init_random_weights(seed=0).init_cache()
+        return app
+
+    def make_engine():
+        return ServingEngine(
+            PagedEngineAdapter(make_app(),
+                               kv_spill_tier=HostKVSpillTier(
+                                   max_blocks=32)),
+            starvation_bound_s=1e9)
+
+    # the fleet precompile plane (ISSUE 16) warms the SHARED persistent
+    # cache once, up front — the precompile-first admission gate then
+    # requires every spawned replica to report n_compiles == 0 off it
+    t_warm = time.perf_counter()
+    warm_report = precompile(make_app())
+    warm_s = time.perf_counter() - t_warm
+
+    clock = [0.0]
+    tick = 0.5
+    auto = FleetAutoscaler(
+        make_engine, min_replicas=1, max_replicas=3,
+        queue_enter=4.0, queue_exit=0.5,
+        burn_enter=1.0, burn_exit=0.25,
+        headroom_enter_slots=0, headroom_exit_slots=2,
+        min_hold_s=1.0, cooldown_s=5.0, now_fn=lambda: clock[0])
+    router = EngineRouter({"r0": make_engine()}, autoscaler=auto)
+
+    arrivals = diurnal_ramp(duration_s=40.0, base_rate=0.3,
+                            peak_rate=5.0, vocab=500, prompt_len=(5, 10),
+                            max_new_tokens=max_new, seed=0)
+    records = []
+    replica_counts = []
+    i = 0
+    t_start = time.perf_counter()
+    while i < len(arrivals) or router.has_work or auto._retiring:
+        clock[0] += tick
+        while i < len(arrivals) and arrivals[i].t <= clock[0]:
+            s = router.submit(list(arrivals[i].prompt),
+                              arrivals[i].max_new_tokens,
+                              tenant=arrivals[i].tenant)
+            records.append({"stream": s, "t_submit": arrivals[i].t,
+                            "t_first": None, "t_done": None})
+            i += 1
+        # ONE fleet pass per virtual half-second: a deliberately tight
+        # per-replica token budget, so the ramp's peak genuinely
+        # oversubscribes one replica and the controller must act
+        router.run_pass()
+        for r in records:
+            if r["t_first"] is None and r["stream"].n_tokens:
+                r["t_first"] = clock[0]
+            if r["t_done"] is None and r["stream"].finished:
+                r["t_done"] = clock[0]
+        replica_counts.append(sum(
+            1 for rep in router.replicas.values()
+            if rep.state in ("healthy", "draining")))
+        assert clock[0] < 3600.0, "autoscale workload wedged"
+    wall = time.perf_counter() - t_start
+
+    assert all(r["stream"].finish_reason == "length" for r in records)
+    ups = [h for h in auto.history if h["action"] == "scale_up"]
+    downs = [h for h in auto.history if h["action"] == "scale_down"]
+    assert ups, "diurnal ramp produced no scale-up"
+    assert downs, "diurnal ramp produced no scale-down"
+    assert all(h["n_compiles"] == 0 for h in ups), \
+        "a scale-up replica compiled at admission (cache not shared?)"
+    # hysteresis: consecutive OPPOSITE actions >= cooldown apart
+    actions = [h for h in auto.history
+               if h["action"] in ("scale_up", "scale_down")]
+    min_flip_gap = min(
+        (b["t"] - a["t"] for a, b in zip(actions, actions[1:])
+         if a["action"] != b["action"]), default=float("inf"))
+    assert min_flip_gap >= auto.cooldown_s, \
+        f"hysteresis violated: opposite actions {min_flip_gap}s apart"
+    ttft = np.asarray([r["t_first"] - r["t_submit"] for r in records])
+    tpot = np.asarray([(r["t_done"] - r["t_first"]) / (max_new - 1)
+                       for r in records])
+    pct = lambda a, q: float(np.percentile(a, q) * 1e3)  # noqa: E731
+    payload = {
+        "metric": "autoscale_scale_actions",
+        "value": len(actions),
+        "unit": "scale_actions_diurnal_ramp_virtual_40s",
+        "details": {
+            "requests": len(records),
+            "scale_ups": len(ups),
+            "scale_downs": len(downs),
+            "timeline": auto.history,
+            "min_opposite_action_gap_s": (
+                None if min_flip_gap == float("inf")
+                else round(min_flip_gap, 2)),
+            "cooldown_s": auto.cooldown_s,
+            "replicas_peak": max(replica_counts),
+            "replicas_final": replica_counts[-1],
+            "migrated_streams": router.stats["migrations"],
+            "migrated_kv_tokens": router.stats["migrated_kv_tokens"],
+            "reaped": auto.stats["reaped"],
+            "autoscaler_stats": dict(auto.stats),
+            "precompile": {"n_graphs": warm_report["n_graphs"],
+                           "warm_wall_s": round(warm_s, 2)},
+            "ttft_virtual_ms": {"p50": round(pct(ttft, 50), 1),
+                                "p99": round(pct(ttft, 99), 1)},
+            "tpot_virtual_ms": {"p50": round(pct(tpot, 50), 1),
+                                "p99": round(pct(tpot, 99), 1)},
+            "virtual_horizon_s": round(clock[0], 1),
+            "wall_s": round(wall, 2),
+            "model": "llama-tiny 2L/64h (synthetic fp32)",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    for rep in router.replicas.values():
+        if not getattr(rep.engine, "closed", False):
+            rep.engine.close()
+    _emit_report_artifact(payload, artifact_path, "autoscale-report")
 
 
 def slo_report_main(artifact_path="artifacts/bench_slo_r14.json"):
@@ -1144,6 +1368,7 @@ def _no_tpu_fallback(error: str):
                      ("ragged_overhead", ragged_overhead_main),
                      ("serving_load", serving_load_main),
                      ("fleet_load", fleet_load_main),
+                     ("autoscale_report", autoscale_report_main),
                      ("slo_report", slo_report_main),
                      ("chaos_report", chaos_report_main),
                      ("graph_report", graph_report_main),
@@ -1202,6 +1427,8 @@ def main():
         return serving_load_main()
     if "--fleet-load" in sys.argv[1:]:
         return fleet_load_main()
+    if "--autoscale-report" in sys.argv[1:]:
+        return autoscale_report_main()
     if "--slo-report" in sys.argv[1:]:
         return slo_report_main()
     if "--chaos-report" in sys.argv[1:]:

@@ -44,6 +44,8 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/fleet/router.py",
     "neuronx_distributed_inference_tpu/serving/fleet/kv_tier.py",
     "neuronx_distributed_inference_tpu/serving/fleet/handoff.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/autoscaler.py",
+    "neuronx_distributed_inference_tpu/serving/fleet/loadgen.py",
     "neuronx_distributed_inference_tpu/resilience/controller.py",
     "neuronx_distributed_inference_tpu/resilience/chaos.py",
 )

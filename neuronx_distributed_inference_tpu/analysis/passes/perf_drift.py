@@ -34,7 +34,8 @@ BASELINE_SCHEMA = "nxdi-perf-baseline-v1"
 #: serving-path structural proxies the drift gate exists to protect.
 MUST_GATE = ("dispatches_per_step", "materialized_per_step",
              "ragged_pad_waste", "precompile_graphs",
-             "golden_collective_bytes")
+             "golden_collective_bytes", "migrations_per_drain",
+             "recompute_avoided_tokens")
 
 
 def golden_bytes_total(golden: Dict[str, Any]) -> int:

@@ -216,6 +216,7 @@ class ChaosCampaign:
             requeues=stats.get("requeues", 0),
             quarantines=stats.get("quarantines", 0),
             replica_failures=stats.get("replica_failures", 0),
+            migrations=stats.get("migrations", 0),
             error=error,
             mismatched=mismatched, missing=missing,
         )
@@ -242,9 +243,10 @@ class ChaosCampaign:
         out-param) collects heal/leak accounting for the cell row."""
         from ..serving import PagedEngineAdapter
         from ..serving.engine import ServingEngine
-        from ..serving.fleet import (EngineRouter, HostKVSpillTier,
-                                     admit_handoff, capture_handoff,
-                                     handoff_from_json, handoff_to_json)
+        from ..serving.fleet import (EngineRouter, FleetAutoscaler,
+                                     HostKVSpillTier, admit_handoff,
+                                     capture_handoff, handoff_from_json,
+                                     handoff_to_json, migrate)
         if stats is None:
             stats = {}
         rng = random.Random(self.seed)
@@ -305,15 +307,25 @@ class ChaosCampaign:
         # C: standalone speculative path (spec_verify dispatches).
         adapter_a = PagedEngineAdapter(app_a, ragged=True, speculation=2,
                                        kv_spill_tier=tier)
-        adapter_b = PagedEngineAdapter(app_b, pipeline_depth=1)
+        adapter_b = PagedEngineAdapter(app_b, pipeline_depth=1,
+                                       kv_spill_tier=HostKVSpillTier(
+                                           max_blocks=64))
         adapter_c = PagedEngineAdapter(app_c, speculation=2)
         eng_a = ServingEngine(adapter_a, starvation_bound_s=1e9)
         eng_b = ServingEngine(adapter_b, starvation_bound_s=1e9)
         eng_c = ServingEngine(adapter_c, starvation_bound_s=1e9)
+        # a pinned-size autoscaler (min == max == 3, so it can never
+        # act): every fleet pass still runs one closed-loop EVALUATION,
+        # which is exactly the "autoscale" fault point — an injected
+        # trip aborts the evaluation with the fleet unchanged, the
+        # documented trivial heal
+        autoscaler = FleetAutoscaler(lambda: None, min_replicas=3,
+                                     max_replicas=3)
         router = EngineRouter(
             {"A": eng_a, "B": eng_b, "C": eng_c},
             backoff_base_s=0.005, backoff_max_s=0.05,
-            quarantine_after=2, max_replica_failures=8, seed=self.seed)
+            quarantine_after=2, max_replica_failures=8, seed=self.seed,
+            autoscaler=autoscaler)
         streams: Dict[str, Any] = {}
         try:
             prefix_b = self._prompt(rng, 2 * bs)
@@ -336,7 +348,34 @@ class ChaosCampaign:
                                          max_new, tenant="tC")
             streams["r1"] = router.submit(
                 prefix_b + self._prompt(rng, 2), max_new)
+            # ---- phase 2.5: live decode→decode migration of r1 -------
+            # move the routed pipelined-decode stream B→A mid-decode and
+            # then back A→B (two capture + two admit traversals, so the
+            # repeated-Nth schedules of migrate_capture / migrate_admit
+            # have a second call to trip on); each leg heals by plain
+            # retry — an injected failure leaves BOTH engines unchanged
+            rid_r1 = streams["r1"].request_id
+
+            def migrate_r1(dst: str):
+                req = router._requests.get(rid_r1)
+                if (req is None or streams["r1"].finished
+                        or req.replica == dst
+                        or router.replicas[req.replica].state == "dead"
+                        or router.replicas[dst].state != "healthy"):
+                    return             # already failed over / finished:
+                    # the stream is bit-identical either way, which is
+                    # the invariant the cell checks
+                migrate(router, rid_r1, dst=dst)
+
+            for _ in range(self.max_passes):
+                if streams["r1"].n_tokens >= 1 or streams["r1"].finished:
+                    break
+                self._drive(router, streams, passes=1)
+            _retrying(lambda: migrate_r1("A"))
+            self._drive(router, streams, passes=1)
+            _retrying(lambda: migrate_r1("B"))
             self._drive(router, streams)
+            stats["migrations"] = router.stats["migrations"]
             stats["unwritten_leaked"] = sum(
                 len(ad._unwritten)
                 for ad, eng in ((adapter_a, eng_a), (adapter_b, eng_b),

@@ -63,6 +63,20 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      fired on BOTH capture and admit — either side fails
                      typed (:class:`~.errors.HandoffError`) with its
                      engine state unchanged
+  ``migrate_capture`` the source-side capture of a live decode→decode
+                     migration (serving/fleet/handoff.py ``migrate``) —
+                     fires BEFORE any source state changes, so a trip
+                     leaves BOTH engines untouched and the un-migrated
+                     stream keeps serving on the source
+  ``migrate_admit``  the destination-side admission of a migration —
+                     fires BEFORE the tier seed and the transactional
+                     re-admission, so a trip leaves the destination's
+                     free pool exact and the source still serving
+                     (typed :class:`~.errors.HandoffError` either way)
+  ``autoscale``      one FleetAutoscaler evaluation
+                     (serving/fleet/autoscaler.py) — a trip aborts that
+                     evaluation (no spawn, no retire) with the fleet
+                     unchanged; serving is never disturbed
 
 Hot-path cost while nothing is armed: a single attribute check
 (``FAULTS.active``) — no call, no allocation (pinned by
@@ -81,7 +95,8 @@ __all__ = ["FAULT_POINTS", "FAULTS", "FaultInjector", "InjectedFault"]
 FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
                 "decode_step", "slow_step", "pipeline_flush",
                 "spec_draft", "spec_verify", "ragged_step",
-                "kv_spill", "kv_restore", "handoff")
+                "kv_spill", "kv_restore", "handoff",
+                "migrate_capture", "migrate_admit", "autoscale")
 
 
 class InjectedFault(RuntimeError):

@@ -269,6 +269,12 @@ class ServingEngine:
         tie-break reads this."""
         return (self.queue.depth, len(self._active))
 
+    def seq_id_of(self, request_id: str):
+        """The adapter seq id of an ADMITTED request, or None while it
+        is still queued / mid-prefill / unknown — the fleet migration
+        path (serving/fleet/handoff.py ``migrate``) captures by seq id."""
+        return self._sid_of.get(request_id)
+
     @property
     def has_work(self) -> bool:
         return bool(self._active) or self.queue.depth > 0

@@ -1,0 +1,186 @@
+"""Seeded workload profiles for fleet benches and chaos campaigns
+(ISSUE 17 prong c).
+
+Pure host code, no device or JAX dependency: each profile returns a
+time-sorted list of :class:`Arrival` records — *when* a request lands,
+*what* prompt it carries, *how many* tokens it wants, and *whose*
+tenant it bills to — that ``bench.py --autoscale-report`` replays
+against an :class:`~.router.EngineRouter` on a virtual clock. All
+randomness flows through one seeded :class:`random.Random`, so a
+profile is a pure function of its arguments: the committed
+``artifacts/bench_autoscale_r17.json`` is reproducible bit-for-bit.
+
+Three shapes, matching the traffic families the autoscaler must
+survive:
+
+  * :func:`diurnal_ramp` — a half-sine ramp from ``base_rate`` up to
+    ``peak_rate`` and back (one "day"): drives ≥1 scale-up on the way
+    up and ≥1 scale-down on the way back down, with the hysteresis
+    dead band visible in between;
+  * :func:`tenant_burst` — steady background traffic plus one tenant
+    slamming in a rectangular burst: exercises per-tenant SLO burn
+    feeding the merged burn index;
+  * :func:`heavy_tail` — Poisson arrivals whose prompt lengths follow
+    a bounded Pareto: a few giant prompts amid many small ones, the
+    classic admission-headroom killer.
+
+Arrival times come from an inhomogeneous Poisson process simulated by
+thinning against the profile's peak rate — standard, and exact for
+piecewise-smooth rate functions.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Callable, List, Sequence, Tuple
+
+from ...resilience.errors import ConfigurationError
+
+__all__ = ["Arrival", "diurnal_ramp", "tenant_burst", "heavy_tail"]
+
+
+@dataclass(frozen=True)
+class Arrival:
+    """One request of a generated workload: submit at ``t`` seconds
+    (virtual, offset from profile start)."""
+    t: float
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    tenant: str
+
+
+def _check_common(duration_s: float, vocab: int,
+                  prompt_len: Tuple[int, int],
+                  max_new_tokens: int) -> None:
+    if duration_s <= 0:
+        raise ConfigurationError("duration_s must be > 0")
+    if vocab < 2:
+        raise ConfigurationError("vocab must be >= 2")
+    lo, hi = prompt_len
+    if not (1 <= lo <= hi):
+        raise ConfigurationError(
+            f"prompt_len must be (lo, hi) with 1 <= lo <= hi, got "
+            f"{prompt_len}")
+    if max_new_tokens < 1:
+        raise ConfigurationError("max_new_tokens must be >= 1")
+
+
+def _prompt(rng: random.Random, vocab: int,
+            prompt_len: Tuple[int, int]) -> Tuple[int, ...]:
+    n = rng.randint(prompt_len[0], prompt_len[1])
+    return tuple(rng.randrange(vocab) for _ in range(n))
+
+
+def _thinned_poisson(rng: random.Random, duration_s: float,
+                     rate_fn: Callable[[float], float],
+                     peak_rate: float) -> List[float]:
+    """Arrival times of an inhomogeneous Poisson process with intensity
+    ``rate_fn(t)`` on [0, duration), by thinning a homogeneous process
+    at ``peak_rate`` (Lewis & Shedler): exact as long as
+    ``rate_fn <= peak_rate`` everywhere, which the callers guarantee by
+    construction."""
+    out: List[float] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(peak_rate)
+        if t >= duration_s:
+            return out
+        if rng.random() * peak_rate <= rate_fn(t):
+            out.append(t)
+
+
+def diurnal_ramp(duration_s: float = 60.0, *, base_rate: float = 0.5,
+                 peak_rate: float = 8.0, vocab: int = 512,
+                 prompt_len: Tuple[int, int] = (4, 12),
+                 max_new_tokens: int = 8, tenant: str = "default",
+                 seed: int = 0) -> List[Arrival]:
+    """One synthetic "day": request rate follows
+    ``base + (peak - base) * sin(pi * t / duration)`` — quiet, ramp to
+    peak mid-window, ramp back down. The canonical autoscaler workload:
+    the up-slope must trigger a scale-up, the down-slope a scale-down,
+    and the dead band in between must hold the fleet steady."""
+    _check_common(duration_s, vocab, prompt_len, max_new_tokens)
+    if not 0 < base_rate < peak_rate:
+        raise ConfigurationError(
+            f"need 0 < base_rate < peak_rate (got {base_rate}, "
+            f"{peak_rate})")
+    rng = random.Random(seed)
+
+    def rate(t: float) -> float:
+        return base_rate + (peak_rate - base_rate) * math.sin(
+            math.pi * t / duration_s)
+
+    return [Arrival(t=t, prompt=_prompt(rng, vocab, prompt_len),
+                    max_new_tokens=max_new_tokens, tenant=tenant)
+            for t in _thinned_poisson(rng, duration_s, rate, peak_rate)]
+
+
+def tenant_burst(duration_s: float = 60.0, *, base_rate: float = 1.0,
+                 burst_rate: float = 8.0, burst_start_s: float = 20.0,
+                 burst_len_s: float = 10.0, vocab: int = 512,
+                 prompt_len: Tuple[int, int] = (4, 12),
+                 max_new_tokens: int = 8,
+                 tenants: Sequence[str] = ("bg", "burst"),
+                 seed: int = 0) -> List[Arrival]:
+    """Steady background traffic from ``tenants[0]`` at ``base_rate``,
+    plus ``tenants[1]`` slamming a rectangular burst of ``burst_rate``
+    for ``burst_len_s`` starting at ``burst_start_s`` — the shape that
+    makes one tenant's SLO burn spike while the fleet average looks
+    fine, exercising the merged-burn (max, not mean) scale-up signal."""
+    _check_common(duration_s, vocab, prompt_len, max_new_tokens)
+    if base_rate <= 0 or burst_rate <= 0:
+        raise ConfigurationError("rates must be > 0")
+    if not 0 <= burst_start_s < duration_s or burst_len_s <= 0:
+        raise ConfigurationError(
+            "burst window must start inside [0, duration_s) with "
+            "burst_len_s > 0")
+    if len(tenants) != 2:
+        raise ConfigurationError(
+            "tenants must be (background, burster) — exactly 2 names")
+    rng = random.Random(seed)
+    bg = [Arrival(t=t, prompt=_prompt(rng, vocab, prompt_len),
+                  max_new_tokens=max_new_tokens, tenant=tenants[0])
+          for t in _thinned_poisson(rng, duration_s,
+                                    lambda t: base_rate, base_rate)]
+    burst_end = min(burst_start_s + burst_len_s, duration_s)
+    burst = [Arrival(t=t, prompt=_prompt(rng, vocab, prompt_len),
+                     max_new_tokens=max_new_tokens, tenant=tenants[1])
+             for t in _thinned_poisson(
+                 rng, duration_s,
+                 lambda t: (burst_rate
+                            if burst_start_s <= t < burst_end else 0.0),
+                 burst_rate)]
+    return sorted(bg + burst, key=lambda a: a.t)
+
+
+def heavy_tail(duration_s: float = 60.0, *, rate: float = 2.0,
+               vocab: int = 512, alpha: float = 1.5,
+               min_prompt: int = 4, max_prompt: int = 48,
+               max_new_tokens: int = 8, tenant: str = "default",
+               seed: int = 0) -> List[Arrival]:
+    """Poisson arrivals whose prompt lengths follow a bounded Pareto
+    (``P(L > x) ~ x^-alpha`` truncated to [min_prompt, max_prompt]):
+    mostly small prompts with rare giants — the shape that drains
+    admission headroom (blocks AND slots) in lumps rather than
+    smoothly, exercising the free-slots scale-up signal."""
+    _check_common(duration_s, vocab, (min_prompt, max_prompt),
+                  max_new_tokens)
+    if rate <= 0:
+        raise ConfigurationError("rate must be > 0")
+    if alpha <= 0:
+        raise ConfigurationError("alpha must be > 0 (tail exponent)")
+    rng = random.Random(seed)
+    out: List[Arrival] = []
+    for t in _thinned_poisson(rng, duration_s, lambda t: rate, rate):
+        # inverse-CDF sample of a bounded Pareto on [min, max]
+        u = rng.random()
+        lo, hi = float(min_prompt), float(max_prompt)
+        x = (lo ** -alpha - u * (lo ** -alpha - hi ** -alpha)) \
+            ** (-1.0 / alpha)
+        n = max(min_prompt, min(max_prompt, int(round(x))))
+        prompt = tuple(rng.randrange(vocab) for _ in range(n))
+        out.append(Arrival(t=t, prompt=prompt,
+                           max_new_tokens=max_new_tokens, tenant=tenant))
+    return out

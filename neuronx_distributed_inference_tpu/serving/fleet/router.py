@@ -29,6 +29,16 @@ The router is synchronous like the engine (:meth:`run_pass` /
 :class:`~..engine.streams.TokenStream` objects whose tokens survive
 failovers. Routing/drain/requeue decisions land on the flight recorder
 (``fleet.route`` / ``fleet.drain``) and the ``nxdi_fleet_*`` metrics.
+
+**Elastic fleet** (ISSUE 17): the replica set is no longer static —
+:meth:`add_replica` / :meth:`remove_replica` resize the rotation (the
+:class:`~.autoscaler.FleetAutoscaler` attached via ``autoscaler=`` is
+consulted once per :meth:`run_pass` and drives them closed-loop),
+:meth:`drain` gains a ``mode="migrate"`` that MOVES running sequences
+to surviving replicas via live decode→decode migration
+(:func:`~.handoff.migrate`) instead of letting drain throw warm KV
+away, and :meth:`rebalance` defragments prefix-affinity hotspots by
+migrating streams off the most-loaded replica.
 """
 
 from __future__ import annotations
@@ -128,7 +138,8 @@ class EngineRouter:
                  backoff_multiplier: float = 2.0,
                  backoff_jitter: float = 0.25,
                  quarantine_after: int = 2,
-                 max_replica_failures: int = 5, seed: int = 0):
+                 max_replica_failures: int = 5, seed: int = 0,
+                 autoscaler: Optional[Any] = None):
         if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
             raise ConfigurationError(
                 "backoff_base_s must be > 0 and <= backoff_max_s")
@@ -179,7 +190,13 @@ class EngineRouter:
             "routed": 0, "affinity_warm": 0, "affinity_cold": 0,
             "requeues": 0, "replica_failures": 0, "completed": 0,
             "drains": 0, "quarantines": 0, "probes": 0,
-            "probe_readmits": 0}
+            "probe_readmits": 0, "migrations": 0, "migrate_failures": 0,
+            "migrated_kv_tokens": 0, "migrate_drains": 0, "rebalances": 0}
+        if autoscaler is not None and not hasattr(autoscaler, "update"):
+            raise ConfigurationError(
+                "autoscaler= must expose update(router) — pass a "
+                "serving.fleet.autoscaler.FleetAutoscaler")
+        self.autoscaler = autoscaler
 
     @contextlib.contextmanager
     def _scoped_registry(self, name: str):
@@ -279,20 +296,26 @@ class EngineRouter:
         fleet section of ``GET /v1/debug/memory``. Each ledger's gauges
         are refreshed into that replica's scoped registry (when
         ``metrics_registries`` is set), so the fleet-aggregated scrape
-        carries ``nxdi_hbm_*{replica=...}`` series. Dead replicas and
-        ledger failures report ``{"error": ...}`` instead of sinking
-        the endpoint."""
+        carries ``nxdi_hbm_*{replica=...}`` series. A replica that is
+        dead — or DIES between enumeration and its ledger walk (closed
+        engine, vanished adapter) — reports a ``{"state": "dead"}``
+        stub instead of sinking the endpoint; other ledger failures
+        report ``{"error": ...}``."""
         out: Dict[str, Any] = {}
-        for name in sorted(self.replicas):
-            rep = self.replicas[name]
-            if rep.state == DEAD:
-                out[name] = {"error": "replica dead"}
+        for name, rep in sorted(list(self.replicas.items())):
+            if rep.state == DEAD or getattr(rep.engine, "closed", False):
+                out[name] = {"state": "dead"}
                 continue
             try:
                 from ..warmup import memory_ledger
                 reg = (self._registries[name]
                        if self._registries is not None else None)
                 out[name] = memory_ledger(rep.engine.adapter, registry=reg)
+            except ServingError:
+                # the replica died under us mid-report (released
+                # adapter, torn-down engine): stub it like DEAD rather
+                # than failing the whole fleet endpoint
+                out[name] = {"state": "dead"}
             except Exception as e:
                 out[name] = {"error": f"{type(e).__name__}: {e}"}
         return out
@@ -364,6 +387,11 @@ class EngineRouter:
         delivered = 0
         for req in list(self._requests.values()):
             delivered += self._pump(req)
+        if self.autoscaler is not None:
+            # closed-loop consult, once per fleet pass: the controller
+            # reads the fresh post-pump signals (queue depth, merged
+            # SLO burn, admission headroom) and may resize the rotation
+            self.autoscaler.update(self)
         return delivered
 
     def backoff_wait_s(self) -> float:
@@ -396,15 +424,60 @@ class EngineRouter:
                 time.sleep(wait)
 
     # -- health ------------------------------------------------------------
-    def drain(self, name: str) -> None:
-        """Stop routing NEW requests to ``name``; running and queued work
-        finishes normally. Idempotent; a dead replica stays dead."""
+    def drain(self, name: str, mode: str = "finish") -> int:
+        """Stop routing NEW requests to ``name``. ``mode="finish"`` (the
+        default): running and queued work finishes normally on the
+        replica. ``mode="migrate"``: every RUNNING in-flight request is
+        additionally MOVED to a surviving healthy replica via live
+        decode→decode migration (:func:`~.handoff.migrate`) — the KV
+        travels, nothing recomputes from scratch; requests that cannot
+        migrate (still queued / mid-prefill, no eligible destination)
+        keep finishing on the draining replica, counted in
+        ``stats["migrate_failures"]``. Returns the number migrated.
+
+        Drain-while-quarantined is explicit: draining a ``backing_off``
+        / ``probation`` replica records the intent (``was_draining``) —
+        the drain completes when the probe re-admits the replica
+        (landing it in ``draining``, not ``healthy``) or escalates to
+        dead per ``max_replica_failures``. Idempotent; a dead replica
+        stays dead."""
+        if mode not in ("finish", "migrate"):
+            raise ConfigurationError(
+                f"drain mode {mode!r} is not one of ('finish', "
+                "'migrate')")
         rep = self._replica(name)
-        if rep.state != HEALTHY:
-            return
-        rep.state = DRAINING
-        self.stats["drains"] += 1
-        self._trace_state(rep, reason="drain")
+        if rep.state == HEALTHY:
+            rep.state = DRAINING
+            self.stats["drains"] += 1
+            self._trace_state(rep, reason="drain")
+        elif rep.state in (BACKING_OFF, PROBATION):
+            # explicit drain-while-quarantined: the probe re-admit path
+            # honors was_draining, so the drain completes as soon as
+            # the replica re-enters rotation (or it escalates to dead)
+            if not rep.was_draining:
+                rep.was_draining = True
+                self.stats["drains"] += 1
+                self._trace_state(rep, reason="drain_quarantined")
+        elif rep.state == DEAD:
+            return 0
+        if mode != "migrate":
+            return 0
+        self.stats["migrate_drains"] += 1
+        from .handoff import migrate
+        from ...resilience.errors import HandoffError
+        moved = 0
+        for req in list(self._requests.values()):
+            if req.replica != name or req.stream.finished:
+                continue
+            try:
+                migrate(self, req.request_id, src=name)
+                moved += 1
+            except HandoffError:
+                # not migratable (queued, mid-prefill, no destination,
+                # or an injected fault): it keeps serving on the
+                # draining replica — drain still completes normally
+                self.stats["migrate_failures"] += 1
+        return moved
 
     def undrain(self, name: str) -> None:
         """Return a draining replica to healthy (dead ones stay dead)."""
@@ -412,6 +485,152 @@ class EngineRouter:
         if rep.state == DRAINING:
             rep.state = HEALTHY
             self._trace_state(rep, reason="undrain")
+
+    # -- elastic fleet -----------------------------------------------------
+    def add_replica(self, name: str, engine, *,
+                    registry: Optional[Any] = None) -> None:
+        """Join a new replica to the rotation, healthy and routable
+        immediately — the :class:`~.autoscaler.FleetAutoscaler` calls
+        this only AFTER the replica's precompile walk reported zero
+        compiles, so admission never exposes traffic to compile stalls.
+        When the router scopes per-replica registries, ``registry`` is
+        required (a fresh :class:`~...telemetry.MetricsRegistry` is
+        auto-created if omitted) and the fleet aggregator starts merging
+        it; without scoped registries ``registry`` must stay None."""
+        if name in self.replicas:
+            raise ConfigurationError(
+                f"replica name {name!r} already in the fleet; have "
+                f"{sorted(self.replicas)}")
+        if not hasattr(engine, "run_pass") or not hasattr(engine, "adapter"):
+            raise ConfigurationError(
+                f"replica {name!r} is not a ServingEngine surface")
+        if self._registries is None:
+            if registry is not None:
+                raise ConfigurationError(
+                    "this router does not scope per-replica registries "
+                    "(metrics_registries=None) — registry= must be None")
+        else:
+            if registry is None:
+                from ...telemetry import MetricsRegistry
+                registry = MetricsRegistry()
+            self._registries[name] = registry
+            if self.aggregator is not None:
+                self.aggregator.sources[name] = registry
+        self.replicas[name] = _Replica(name, engine)
+        self._trace_state(self.replicas[name], reason="join")
+
+    def remove_replica(self, name: str) -> None:
+        """Drop a replica from the rotation entirely (vs. :meth:`drain`,
+        which keeps it parked). Refused while any in-flight fleet
+        request is still bound to it — drain/migrate first. The scoped
+        registry (and its aggregator source) leaves with it, so the
+        fleet scrape stops advertising the retired replica."""
+        rep = self._replica(name)
+        bound = [rid for rid, req in self._requests.items()
+                 if req.replica == name and not req.stream.finished]
+        if bound:
+            raise ServingError(
+                f"replica {name!r} still serves {len(bound)} in-flight "
+                f"request(s) ({sorted(bound)[:4]}...) — drain(mode="
+                "'migrate') before remove_replica")
+        self._trace_state(rep, reason="remove")
+        del self.replicas[name]
+        if self._registries is not None:
+            self._registries.pop(name, None)
+        if self.aggregator is not None:
+            self.aggregator.sources.pop(name, None)
+
+    def rebalance(self, max_moves: int = 4) -> int:
+        """Defragment prefix-affinity hotspots: while the most-loaded
+        healthy replica carries at least 2 more running streams than the
+        least-loaded one, live-migrate one stream from hot to cold
+        (warmest-on-destination first, so the move costs the least
+        recompute-adjacent warmth). Bounded by ``max_moves`` per call;
+        returns how many streams moved. Streams that refuse to migrate
+        (mid-prefill, fault-injected) are skipped, not retried."""
+        if max_moves < 1:
+            raise ConfigurationError("rebalance max_moves must be >= 1")
+        from ...resilience.errors import HandoffError
+        from .handoff import migrate
+        moved = 0
+        skipped: set = set()
+        while moved < max_moves:
+            counts: Dict[str, int] = {
+                n: 0 for n, rep in self.replicas.items()
+                if rep.state == HEALTHY}
+            for req in self._requests.values():
+                if req.replica in counts and not req.stream.finished:
+                    counts[req.replica] += 1
+            hot = max(sorted(counts), key=lambda n: counts[n],
+                      default=None)
+            # only the DESTINATION needs a spill tier (the payload
+            # lands through KVSpillTier.seed); any replica can donate
+            sinks = {n: c for n, c in counts.items()
+                     if n != hot
+                     and hasattr(self.replicas[n].engine.adapter,
+                                 "_kv_tier")}
+            if hot is None or not sinks:
+                break
+            cold = min(sorted(sinks), key=lambda n: sinks[n])
+            if counts[hot] - counts[cold] < 2:
+                break
+            candidates = [req for req in self._requests.values()
+                          if req.replica == hot
+                          and not req.stream.finished
+                          and req.request_id not in skipped]
+            if not candidates:
+                break
+            progressed = False
+            for req in candidates:
+                try:
+                    migrate(self, req.request_id, src=hot, dst=cold)
+                except HandoffError:
+                    skipped.add(req.request_id)
+                    continue
+                moved += 1
+                progressed = True
+                break
+            if not progressed:
+                break
+        if moved:
+            self.stats["rebalances"] += 1
+        return moved
+
+    def _pick_migration_dst(self, req: _FleetRequest,
+                            exclude: str) -> str:
+        """The destination replica for one live migration: healthy, not
+        the source, and spill-tier-capable (the KV payload lands through
+        ``KVSpillTier.seed``); warmest on the sequence-so-far first,
+        then least load, then stable name order. Raises
+        :class:`~...resilience.errors.HandoffError` when no replica
+        qualifies (the un-migrated stream keeps serving on the source)."""
+        from ...resilience.errors import HandoffError
+        seq = list(req.prompt) + list(req.stream.tokens)
+        best = None
+        for name in sorted(self.replicas):
+            rep = self.replicas[name]
+            if name == exclude or rep.state != HEALTHY:
+                continue
+            if getattr(rep.engine, "closed", False):
+                continue
+            if not hasattr(rep.engine.adapter, "_kv_tier"):
+                continue               # nowhere to land the KV payload
+            try:
+                warmth = int(rep.engine.adapter.prefix_warmth(seq))
+            except ServingError:
+                warmth = 0
+            load = getattr(rep.engine, "load", None)
+            if load is None:
+                ds = rep.engine.debug_state()
+                load = (ds["queue"]["depth"], len(ds["active"]))
+            key = (-warmth, tuple(load), name)
+            if best is None or key < best[0]:
+                best = (key, name)
+        if best is None:
+            raise HandoffError(
+                f"no migration destination for {req.request_id!r}: no "
+                f"healthy spill-tier-capable replica besides {exclude!r}")
+        return best[1]
 
     def _replica(self, name: str) -> _Replica:
         rep = self.replicas.get(name)
@@ -632,7 +851,7 @@ class EngineRouter:
         in-flight request → replica binding."""
         now = time.perf_counter()
         replicas = {}
-        for name, rep in self.replicas.items():
+        for name, rep in list(self.replicas.items()):
             entry: Dict[str, Any] = {"state": rep.state,
                                      "failures": rep.failures,
                                      "quarantines": rep.quarantines}
@@ -640,7 +859,16 @@ class EngineRouter:
                 entry["backoff_remaining_s"] = round(
                     max(rep.backoff_until - now, 0.0), 4)
             if rep.state != DEAD:
-                ds = rep.engine.debug_state()
+                try:
+                    ds = rep.engine.debug_state()
+                except Exception:
+                    # the replica died between enumeration and report
+                    # (engine torn down under us): serve a dead stub
+                    # instead of sinking GET /v1/debug/state
+                    replicas[name] = {"state": DEAD,
+                                      "failures": rep.failures,
+                                      "quarantines": rep.quarantines}
+                    continue
                 entry.update(queue_depth=ds["queue"]["depth"],
                              active=len(ds["active"]),
                              closed=ds["closed"])

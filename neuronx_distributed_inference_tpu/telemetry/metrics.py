@@ -95,7 +95,8 @@ SPEC_VERIFY_WIDTH = "nxdi_spec_verify_width"                     # engine
 # -- fleet layer (serving/fleet/) --------------------------------------------
 FLEET_ROUTED_TOTAL = "nxdi_fleet_routed_total"       # replica, affinity
 FLEET_REQUEUES_TOTAL = "nxdi_fleet_requeues_total"   # replica
-HANDOFFS_TOTAL = "nxdi_handoff_total"                # role=send|recv
+HANDOFFS_TOTAL = "nxdi_handoff_total"                # role=send|recv|migrate_*
+FLEET_REPLICAS = "nxdi_fleet_replicas"               # state
 
 # -- host-RAM KV spill tier (serving/fleet/kv_tier.py) -----------------------
 KV_SPILL_BLOCKS_TOTAL = "nxdi_kv_spill_blocks_total"
@@ -477,8 +478,18 @@ def handoffs_counter(reg):
     return reg.counter(
         HANDOFFS_TOTAL,
         "Disaggregated prefill/decode handoffs (role=send on capture, "
-        "role=recv on decode-side admission)",
+        "role=recv on decode-side admission) and live decode->decode "
+        "migrations (role=migrate_send / migrate_recv)",
         labels=("role",))
+
+
+def fleet_replicas_gauge(reg):
+    return reg.gauge(
+        FLEET_REPLICAS,
+        "Replicas in the fleet router's rotation by health state "
+        "(healthy/draining/backing_off/probation/dead) — refreshed by "
+        "every FleetAutoscaler evaluation",
+        labels=("state",))
 
 
 def kv_spill_blocks_counter(reg):

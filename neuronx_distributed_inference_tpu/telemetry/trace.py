@@ -95,8 +95,13 @@ APP_EVENTS = ("run.prefill", "run.decode", "run.decode_loop", "run.paged",
 #:   ``handoff.recv``   a decode-role engine admitted a handoff record
 #:   ``fleet.all_dead`` the LAST healthy replica left rotation — the
 #:                      operator page (replica, reason, in_flight)
+#:   ``fleet.scale_up`` the FleetAutoscaler added a replica (replica,
+#:                      reason, n_compiles, queue, burn, free_slots)
+#:   ``fleet.scale_down`` the FleetAutoscaler started retiring a replica
+#:                      (replica, reason, migrated, queue, burn)
 FLEET_EVENTS = ("fleet.route", "fleet.drain", "kv.spill", "kv.restore",
-                "handoff.send", "handoff.recv", "fleet.all_dead")
+                "handoff.send", "handoff.recv", "fleet.all_dead",
+                "fleet.scale_up", "fleet.scale_down")
 
 #: Degradation-controller events (resilience/controller.py). STABLE
 #: names; both carry ``tenant``, ``action`` and the deciding ``burn``.

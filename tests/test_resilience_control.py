@@ -577,6 +577,9 @@ def test_fault_points_lint_green_and_rename_red(tmp_path):
             _FAULTS.fire("kv_spill")
             _FAULTS.fire("kv_restore")
             _FAULTS.fire("handoff")
+            _FAULTS.fire("migrate_capture")
+            _FAULTS.fire("migrate_admit")
+            _FAULTS.fire("autoscale")
         """))
     ctx = analysis.LintContext(tmp_path)
     findings = fp_pass.run(ctx, paths=[str(doctored), str(fire_all)])
@@ -635,6 +638,29 @@ def test_chaos_smoke_seeded_subset(apps):
         assert row["ok"], row
         assert row["trips"] >= 1
     assert report["ok"]
+    for app in apps:                            # campaign left no state
+        assert not app.kv_mgr.tables
+
+
+def test_chaos_migration_and_autoscale_cells(apps):
+    """The ISSUE-17 cells, explicitly: killing a replica mid-migration at
+    BOTH migration fault points x BOTH schedules (and aborting the
+    autoscaler evaluation) heals with zero lost streams — every stream
+    bit-identical to its golden, free pools exact, the armed point
+    actually fired."""
+    campaign = ChaosCampaign(list(apps), seed=0)
+    cells = default_cells(points=["migrate_capture", "migrate_admit",
+                                  "autoscale"])
+    assert len(cells) == 6                      # 3 points x 2 schedules
+    report = campaign.run(cells)
+    for row in report["cells"]:
+        assert row["ok"], row
+        assert row["trips"] >= 1                # the armed point fired
+        assert row["checks"]["free_pool_exact"], row
+        assert row["checks"]["streams_bit_identical"], row
+    assert report["ok"]
+    # the migration legs genuinely ran in every cell (not vacuous)
+    assert all(row["migrations"] >= 1 for row in report["cells"])
     for app in apps:                            # campaign left no state
         assert not app.kv_mgr.tables
 
