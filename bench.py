@@ -1252,14 +1252,18 @@ def lint_report_main(artifact_path="artifacts/lint_report_r10.json"):
     return 0 if not report.findings else 1
 
 
-def _observatory_reports(mesh, label):
+def _observatory_reports(mesh, label, quantized=False):
     """Build the tiny paged + cb serving apps (on the dp2 x tp2 CPU mesh
     when ``mesh``) and run the compiled-graph observatory over both —
     the shared core of ``--graph-report`` and ``--sharding-report``. The
     heartbeat line carries the gauge totals (compile seconds, collective
-    bytes) so BENCH_* rounds surface regressions without hardware."""
+    bytes) so BENCH_* rounds surface regressions without hardware. With
+    ``quantized`` (mesh only) a third app — the same cb config with
+    ``CollectiveConfig(dtype="int8")`` — is analyzed as ``cb_int8`` so
+    the report carries the quantized-collective comm-roofline delta."""
     from neuronx_distributed_inference_tpu import telemetry
-    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.config import (CollectiveConfig,
+                                                          TpuConfig)
     from neuronx_distributed_inference_tpu.models.application import (
         CausalLMApplication, PagedCausalLMApplication)
     from neuronx_distributed_inference_tpu.models.llama import (
@@ -1275,6 +1279,13 @@ def _observatory_reports(mesh, label):
         app.init_random_weights(seed=0).init_cache()
         return observatory.analyze_app(app)
 
+    def cb_tcfg(**extra):
+        return TpuConfig(
+            batch_size=2, seq_len=128, dtype="float32",
+            enable_bucketing=True, context_encoding_buckets=[16, 64],
+            is_continuous_batching=True, decode_chunk_tokens=8,
+            **mesh_fields, **extra)
+
     reg = telemetry.enable()
     try:
         reports = {
@@ -1285,12 +1296,11 @@ def _observatory_reports(mesh, label):
                 is_prefix_caching=True,
                 **(dict(decode_chunk_tokens=4, **mesh_fields)
                    if mesh else {}))),
-            "cb": analyze(CausalLMApplication, TpuConfig(
-                batch_size=2, seq_len=128, dtype="float32",
-                enable_bucketing=True, context_encoding_buckets=[16, 64],
-                is_continuous_batching=True, decode_chunk_tokens=8,
-                **mesh_fields)),
+            "cb": analyze(CausalLMApplication, cb_tcfg()),
         }
+        if quantized and mesh:
+            reports["cb_int8"] = analyze(CausalLMApplication, cb_tcfg(
+                collective_config=CollectiveConfig(dtype="int8")))
         line = reg.stats_line()
         if line:
             print(f"[bench telemetry | {label}] {line}", file=sys.stderr)
@@ -1309,14 +1319,83 @@ def _emit_report_artifact(payload, artifact_path, label):
         print(f"{label} artifact write failed: {e}", file=sys.stderr)
 
 
-def sharding_report_main(artifact_path="artifacts/sharding_report_r09.json"):
-    """CPU-mesh sharding-observatory report (ISSUE 8): AOT-compile the
-    tiny synthetic serving apps (paged + cb) over a dp2 x tp2 CPU mesh,
-    census every collective in the partitioned HLO (kind x mesh-axis comm
-    group, payload bytes) and report the three-way
-    compute/memory/comm-bound roofline per graph under the assumed chip
-    constants (NXDI_TPU_PEAK_TFLOPS / NXDI_TPU_HBM_GBPS /
-    NXDI_TPU_ICI_GBPS / NXDI_TPU_DCN_GBPS). One parseable JSON line + an
+def _project_70b_v5e32():
+    """Analytic decode roofline for Llama-70B on a v5e-32 pod slice as
+    dp4 x tp8 with dp crossing the DCN boundary (``parallel.mesh
+    .DP_OVER_DCN``) — the scale-out shape the quantized collectives
+    target. Pure math under the same chip constants the observatory
+    prices with (NXDI_TPU_* env overrides honored), so the projection
+    line trends with the measured census in one artifact. The comm leg
+    is the per-decode-step row-parallel exchange (o_proj + down_proj per
+    layer), priced fp32 vs int8+fp32-scales; dp carries ZERO per-step
+    decode collectives — that independence is exactly why dp is the axis
+    that may leave the slice."""
+    peak_tflops = float(os.environ.get("NXDI_TPU_PEAK_TFLOPS", "197"))
+    hbm_gbps = float(os.environ.get("NXDI_TPU_HBM_GBPS", "819"))
+    ici_gbps = float(os.environ.get("NXDI_TPU_ICI_GBPS", "200"))
+    dcn_gbps = float(os.environ.get("NXDI_TPU_DCN_GBPS", "25"))
+    # Llama-70B geometry
+    L, H, I, V = 80, 8192, 28672, 128256
+    n_kv, hd = 8, 128
+    tp, dp, batch = 8, 4, 8          # per-replica decode batch
+    params = (L * (2 * H * H + 2 * H * n_kv * hd + 3 * H * I)
+              + 2 * V * H)
+    # memory leg: every weight byte streams from HBM once per step
+    wbytes_bf16 = params * 2 / tp
+    t_mem = wbytes_bf16 / (hbm_gbps * 1e9)
+    # compute leg: 2 flops/param/token, tp-sharded
+    t_comp = 2.0 * params * batch / tp / (peak_tflops * 1e12)
+    # comm leg: 2 row-parallel all-reduces of (batch, 1, H) per layer,
+    # ring wire factor 2(g-1)/g over the tp=8 ICI axis
+    elems = 2 * L * batch * H
+    factor = 2.0 * (tp - 1) / tp
+    wire_f32 = factor * elems * 4
+    # int8 payload + blockwise fp32 scales (1 scale per 32 elements)
+    wire_int8 = factor * elems * (1 + 4 / 32)
+    t_comm_f32 = wire_f32 / (ici_gbps * 1e9)
+    t_comm_int8 = wire_int8 / (ici_gbps * 1e9)
+    step_f32 = max(t_mem, t_comp, t_comm_f32)
+    step_int8 = max(t_mem, t_comp, t_comm_int8)
+    return {
+        "model": "llama-70b 80L/8192h (projection, not measured)",
+        "slice": "v5e-32 as dp4 x tp8, dp over DCN",
+        "assumptions": {"peak_tflops": peak_tflops,
+                        "hbm_gbps": hbm_gbps, "ici_gbps": ici_gbps,
+                        "dcn_gbps": dcn_gbps,
+                        "decode_batch_per_replica": batch,
+                        "weights": "bf16 (17.6 GB/chip at tp8 — over "
+                                   "v5e's 16 GB HBM; int8 weights or "
+                                   "tp16 needed to actually fit)"},
+        "params": params,
+        "t_memory_ms": round(t_mem * 1e3, 4),
+        "t_compute_ms": round(t_comp * 1e3, 4),
+        "t_comm_ms_fp32_collectives": round(t_comm_f32 * 1e3, 4),
+        "t_comm_ms_int8_collectives": round(t_comm_int8 * 1e3, 4),
+        "comm_wire_bytes_fp32": int(wire_f32),
+        "comm_wire_bytes_int8": int(wire_int8),
+        "comm_bytes_saved": int(wire_f32 - wire_int8),
+        "dcn_step_bytes": 0,
+        "dcn_note": "dp replicas are decode-independent: no per-step "
+                    "collective crosses the DCN; only admission, KV "
+                    "migration and weight distribution ride it",
+        "bound_fp32": ("comm" if t_comm_f32 >= max(t_mem, t_comp)
+                       else "memory" if t_mem >= t_comp else "compute"),
+        "est_step_ms_fp32": round(step_f32 * 1e3, 4),
+        "est_step_ms_int8": round(step_int8 * 1e3, 4),
+    }
+
+
+def sharding_report_main(artifact_path="artifacts/sharding_report_r18.json"):
+    """CPU-mesh sharding-observatory report (ISSUE 8, quantized legs
+    ISSUE 18): AOT-compile the tiny synthetic serving apps (paged + cb +
+    the cb app with int8 quantized collectives) over a dp2 x tp2 CPU
+    mesh, census every collective in the partitioned HLO (kind x
+    mesh-axis comm group x wire dtype, payload bytes) and report the
+    three-way compute/memory/comm-bound roofline per graph under the
+    assumed chip constants (NXDI_TPU_PEAK_TFLOPS / NXDI_TPU_HBM_GBPS /
+    NXDI_TPU_ICI_GBPS / NXDI_TPU_DCN_GBPS). Details carry the measured
+    fp32-vs-int8 comm-roofline delta on the decode graphs and the
+    analytic 70B-on-v5e-32 projection. One parseable JSON line + an
     artifact file, no TPU required: this is the hardware-free evidence
     trail for collective regressions on the serving graphs —
     `scripts/check_spmd_sharding.py` turns the same census into a red
@@ -1334,11 +1413,23 @@ def sharding_report_main(artifact_path="artifacts/sharding_report_r09.json"):
                        "before the device-count flag could land)"}))
         return
 
-    reports = _observatory_reports(mesh=True, label="sharding report")
+    reports = _observatory_reports(mesh=True, label="sharding report",
+                                   quantized=True)
     total_bytes = sum(r["totals"]["collective_bytes"]
                       for r in reports.values())
     bounds = {f"{name}/{g['kind']}/{g['bucket']}": g["roofline"]["bound"]
               for name, r in reports.items() for g in r["graphs"]}
+
+    def decode_leg(name):
+        # the cb decode step (bucket "b<batch>") — the graph the
+        # quantized ring rewrites
+        g = next(g for g in reports[name]["graphs"]
+                 if g["kind"] == "decode")
+        return {"collective_bytes": g["collective_bytes"],
+                "t_comm_ms": g["roofline"]["t_comm_ms"],
+                "comm_bytes_saved": g["roofline"]["comm_bytes_saved"]}
+
+    f32_leg, int8_leg = decode_leg("cb"), decode_leg("cb_int8")
     payload = {
         "metric": "sharding_report_collective_bytes_total",
         "value": total_bytes,
@@ -1349,6 +1440,14 @@ def sharding_report_main(artifact_path="artifacts/sharding_report_r09.json"):
             "device": str(jax.devices()[0]),
             "mesh": reports["paged"]["mesh"],
             "roofline_bounds": bounds,
+            "quantized_comm_delta": {
+                "graph": "cb decode b2",
+                "collective_dtype": "int8",
+                "fp32": f32_leg,
+                "int8": int8_leg,
+                "comm_bytes_saved": int8_leg["comm_bytes_saved"],
+            },
+            "projection_70b_v5e32": _project_70b_v5e32(),
             "apps": reports,
         },
     }

@@ -51,6 +51,11 @@ JIT_SITE_PATHS = (
 )
 REGION_PATHS = (
     "neuronx_distributed_inference_tpu/models/model_base.py",
+    # quantized-collective call chain: model_base._row_parallel_out ->
+    # layers.row_parallel_output -> collectives.quantized_row_parallel
+    # (the shard_map ring bodies are traced regions too)
+    "neuronx_distributed_inference_tpu/parallel/layers.py",
+    "neuronx_distributed_inference_tpu/parallel/collectives.py",
 ) + JIT_SITE_PATHS
 
 CONFIG_PARAM_NAMES = {"self", "spec", "cfg", "config", "tpu_cfg",

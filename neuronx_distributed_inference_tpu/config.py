@@ -185,6 +185,27 @@ class TensorReplacementConfig:
         return dataclasses.asdict(self)
 
 
+@dataclass
+class CollectiveConfig:
+    """Quantized decode-collective knobs (EQuARX-style wire compression,
+    PAPERS.md arxiv 2506.17615).
+
+    dtype: "int8" | "fp8" | None. None (default) keeps the implicit GSPMD
+    fp32 collectives — graphs are bit-unchanged. int8/fp8 swaps the
+    row-parallel decode all-reduce for a shard_map ring exchange whose wire
+    payload is quantized (parallel/collectives.py); accumulation stays full
+    precision.
+    block: absmax-scale block size along each ring chunk — the activation
+    analog of the weight stack's blockwise_symmetric group_size.
+    """
+
+    dtype: Optional[str] = None
+    block: int = 32
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
 _SUBCONFIG_TYPES = {
     "on_device_sampling_config": OnDeviceSamplingConfig,
     "chunked_prefill_config": ChunkedPrefillConfig,
@@ -193,6 +214,7 @@ _SUBCONFIG_TYPES = {
     "speculation_config": SpeculationConfig,
     "tensor_capture_config": TensorCaptureConfig,
     "tensor_replacement_config": TensorReplacementConfig,
+    "collective_config": CollectiveConfig,
 }
 
 
@@ -311,6 +333,9 @@ class TpuConfig:
     # kv_cache_manager.py:661-692); 1.0 = direct cast
     kv_cache_scale: float = 1.0
 
+    # --- quantized decode collectives (parallel/collectives.py) ---
+    collective_config: Optional[CollectiveConfig] = None
+
     # --- kernels (reference: models/config.py:417-567 — ~25 enable flags) ---
     # None/False = XLA attention path (measured faster than the v1 Pallas
     # kernel on v5e); True = opt into the Pallas flash prefill kernel where
@@ -405,6 +430,18 @@ class TpuConfig:
         spec = self.speculation_config
         if spec and spec.enable_eagle_speculation and not spec.enable_fused_speculation:
             raise ValueError("EAGLE speculation requires fused speculation")
+        cc = self.collective_config
+        if cc is not None and cc.dtype is not None:
+            # typed refusal shared with parallel/collectives.py (lazy import:
+            # resilience is self-contained, but config loads first at startup)
+            from .resilience.errors import ConfigurationError
+            if cc.dtype not in ("int8", "fp8"):
+                raise ConfigurationError(
+                    f"collective_config.dtype {cc.dtype!r} unsupported: "
+                    "expected 'int8', 'fp8', or None")
+            if cc.block < 1:
+                raise ConfigurationError(
+                    "collective_config.block must be >= 1")
 
     # -- dtype helpers --
     @property

@@ -48,7 +48,8 @@ from ..ops.rope import RopeConfig, apply_rope, rope_cos_sin
 from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
                                expert_column_parallel, expert_row_parallel,
                                replicated_param, resolve_gqa_sharding,
-                               row_parallel, vocab_parallel_embedding)
+                               row_parallel, row_parallel_output,
+                               vocab_parallel_embedding)
 from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
@@ -275,6 +276,13 @@ class DecoderSpec:
     # rescaled on read (reference: kv_cache_manager.py:636-692 scaled fp8
     # mode; None = direct cast)
     kv_scale: Optional[float] = None
+    # quantized decode collectives (parallel/collectives.py, EQuARX-style):
+    # wire dtype for the row-parallel o_proj/down_proj reduction during the
+    # decode and paged phases ("int8"/"fp8"); None keeps the implicit fp32
+    # GSPMD all-reduce and the graphs bit-unchanged. Prefill always stays on
+    # the fp32 collective — its reduction is amortized over the whole prompt.
+    collective_dtype: Optional[str] = None
+    collective_block: int = 32
     # --- recurrent / hybrid state axis (reference: contrib/models/
     # Falcon-H1-0.5B-Instruct hybrid attention+mamba2 and contrib/models/
     # recurrentgemma-2b-it Griffin blocks — a SECOND cache pytree of
@@ -833,6 +841,19 @@ def _layer_body(spec: DecoderSpec, hidden, layer_w, k_full, v_full, li,
     return hidden, k_full, v_full, caps
 
 
+def _row_parallel_out(spec: DecoderSpec, x, w, phase: str):
+    """Row-parallel output reduction for o_proj / down_proj: the quantized
+    ring exchange during decode/paged phases when the collective knob is on
+    ("paged" covers the whole paged serving family including its context
+    graphs — the unified ragged dispatch mixes both in one step), otherwise
+    the plain (q)linear whose all-reduce GSPMD inserts."""
+    if spec.collective_dtype is not None and phase in ("decode", "paged"):
+        return row_parallel_output(x, w,
+                                   collective_dtype=spec.collective_dtype,
+                                   collective_block=spec.collective_block)
+    return qlinear(x, w)
+
+
 def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids,
                phase: str = "prefill"):
     """The MLP / MoE half of a layer (GLU, plain 2-layer, or routed MoE)."""
@@ -866,7 +887,8 @@ def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids,
             inter = inter + layer_w["gate_bias"]
         inter = _shard(act(inter), AXIS_DP, None, AXIS_MP)
         y = apply_lora(spec.lora, layer_w, "down_proj", inter,
-                       qlinear(inter, layer_w["down_proj"]), adapter_ids)
+                       _row_parallel_out(spec, inter, layer_w["down_proj"],
+                                         phase), adapter_ids)
         if spec.mlp_bias:
             y = y + layer_w["down_bias"]
         return y
@@ -879,7 +901,8 @@ def _mlp_block(spec: DecoderSpec, x_in, layer_w, mlp_kind, adapter_ids,
         up = up + layer_w["up_bias"]
     inter = _shard(act(gate) * up, AXIS_DP, None, AXIS_MP)
     y = apply_lora(spec.lora, layer_w, "down_proj", inter,
-                   qlinear(inter, layer_w["down_proj"]), adapter_ids)
+                   _row_parallel_out(spec, inter, layer_w["down_proj"],
+                                     phase), adapter_ids)
     if spec.mlp_bias:
         y = y + layer_w["down_bias"]
     return y
@@ -1217,7 +1240,7 @@ def _attn_block(spec: DecoderSpec, h, layer_w, k_full, v_full, li, ai,
                                                v_all.shape[2]))
 
     attn_out = attn_out.reshape(h.shape[0], h.shape[1], -1)
-    h = qlinear(attn_out, layer_w["o_proj"])
+    h = _row_parallel_out(spec, attn_out, layer_w["o_proj"], phase)
     if spec.mla is None:
         h = apply_lora(spec.lora, layer_w, "o_proj", attn_out, h, adapter_ids)
     if spec.o_bias:
@@ -2311,6 +2334,10 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         capture=(tuple(tcfg.tensor_capture_config.capture_targets)
                  if tcfg.tensor_capture_config else None),
         kv_scale=(tcfg.kv_cache_scale if tcfg.kv_cache_quant else None),
+        collective_dtype=(tcfg.collective_config.dtype
+                          if tcfg.collective_config else None),
+        collective_block=(tcfg.collective_config.block
+                          if tcfg.collective_config else 32),
     )
     kw.update(overrides)
     if kw.get("moe") is not None:

@@ -67,6 +67,24 @@ def row_parallel(in_dim: int, out_dim: int, dtype=jnp.bfloat16,
     return ParamSpec((in_dim, out_dim), P(AXIS_MP, None), dtype)
 
 
+def row_parallel_output(x, w, *, collective_dtype: Optional[str] = None,
+                        collective_block: int = 32):
+    """Compute a row-parallel layer's output: ``x`` (B, T, K) with K sharded
+    over ("ep","tp"), ``w`` (K, N) per :func:`row_parallel`.
+
+    With ``collective_dtype`` None this is the classic GSPMD form — a plain
+    (q)linear whose all-reduce XLA inserts from the sharding constraints.
+    With "int8"/"fp8" the reduction is EXPLICIT: a shard_map ring exchange
+    with a quantized wire payload (parallel/collectives.py, EQuARX-style).
+    """
+    if collective_dtype is None:
+        from ..modules.quantization import qlinear
+        return qlinear(x, w)
+    from . import collectives
+    return collectives.quantized_row_parallel(
+        x, w, dtype=collective_dtype, block=collective_block)
+
+
 def vocab_parallel_embedding(vocab: int, hidden: int, dtype=jnp.bfloat16) -> ParamSpec:
     """Embedding (V, H) sharded on V (reference: ParallelEmbedding with
     vocab_parallel, models/config.py:142)."""

@@ -69,6 +69,46 @@ class MeshConfig:
         return self.dp * self.cp * self.ep * self.tp
 
 
+@dataclass(frozen=True)
+class Topology:
+    """Physical placement of the mesh axes: which axes cross the DCN boundary.
+
+    A single slice rides ICI end to end. Scaling out — the 70B-on-v5e-32
+    shape is dp4(x)tp8 over four 8-chip hosts — puts the OUTERMOST mesh axes
+    on the data-center network, which is ~an order of magnitude slower than
+    ICI (priced by the observatory at ``NXDI_TPU_DCN_GBPS`` vs
+    ``NXDI_TPU_ICI_GBPS``). MESH_AXES is ordered outermost-first exactly so
+    the dp axis is the one that can leave the slice: dp traffic is
+    whole-replica independent during decode (no per-step all-reduce), so it
+    tolerates DCN latency where tp cannot.
+    """
+
+    dcn_axes: Tuple[str, ...] = ()
+
+    def is_dcn(self, comm_axes) -> bool:
+        """True when a collective over ``comm_axes`` crosses the DCN."""
+        return any(a in self.dcn_axes for a in comm_axes)
+
+
+#: single-slice default — every axis on ICI
+SINGLE_SLICE = Topology()
+#: the scale-out shape: dp crosses the DCN boundary, tp/ep/cp stay on ICI
+DP_OVER_DCN = Topology(dcn_axes=(AXIS_DP,))
+
+
+def topology_from_env() -> Topology:
+    """Resolve the deployment topology from ``NXDI_TPU_DCN_AXES`` (comma
+    separated mesh axis names; default "dp" — the conservative pricing:
+    anything dp-attributed is assumed to cross the DCN)."""
+    raw = os.environ.get("NXDI_TPU_DCN_AXES", AXIS_DP)
+    axes = tuple(a for a in (s.strip() for s in raw.split(",")) if a)
+    bad = [a for a in axes if a not in MESH_AXES]
+    if bad:
+        raise ValueError(f"NXDI_TPU_DCN_AXES names unknown mesh axes {bad}; "
+                         f"expected a subset of {MESH_AXES}")
+    return Topology(dcn_axes=axes)
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:

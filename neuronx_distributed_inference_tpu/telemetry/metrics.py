@@ -303,16 +303,17 @@ def graph_collectives_gauge(reg):
         "Collective ops censused across an app's partitioned (post-SPMD) "
         "graphs; kind=all_reduce|all_gather|reduce_scatter|"
         "collective_permute|all_to_all, comm=the mesh-axis subset the "
-        "replica groups ride (static census — loop bodies count once)",
-        labels=("kind", "comm"))
+        "replica groups ride, dtype=the wire payload element type "
+        "(f32|s8|f8e4m3fn|...) (static census — loop bodies count once)",
+        labels=("kind", "comm", "dtype"))
 
 
 def graph_collective_bytes_gauge(reg):
     return reg.gauge(
         GRAPH_COLLECTIVE_BYTES,
         "Result-tensor payload bytes of the censused collectives "
-        "(summed over an app's graph set per kind x comm)",
-        labels=("kind", "comm"))
+        "(summed over an app's graph set per kind x comm x dtype)",
+        labels=("kind", "comm", "dtype"))
 
 
 def run_seconds_histogram(reg):

@@ -41,14 +41,20 @@ compile yields the **post-SPMD partitioned** HLO, and
 (all-reduce / all-gather / reduce-scatter / collective-permute /
 all-to-all), payload bytes, and the replica-group shape mapped back to
 the mesh axes the groups ride (``comm="tp"`` / ``"dp"`` / ``"ep+tp"`` /
-…). The census lands per graph in the report, in the
+…) plus the wire payload dtype (``f32`` / ``s8`` / ``f8e4m3fn`` — the
+dimension that makes the quantized-collective win census-visible). The
+census lands per graph in the report, in the
 ``nxdi_graph_collectives_total`` / ``nxdi_graph_collective_bytes``
-gauges (labels ``kind``+``comm``), and in a third roofline leg: the
-estimated collective wire time under ``NXDI_TPU_ICI_GBPS`` (default 200
-GB/s — v5e ICI) and ``NXDI_TPU_DCN_GBPS`` (default 25 GB/s; ``dp``-axis
-collectives are priced at DCN, everything else at ICI), upgrading the
-per-graph verdict to compute- vs memory- vs **comm**-bound — the regime
-EQuARX (PAPERS.md arxiv 2506.17615) shows dominates DCN-scale decode.
+gauges (labels ``kind``+``comm``+``dtype``), and in a third roofline
+leg: the estimated collective wire time under ``NXDI_TPU_ICI_GBPS``
+(default 200 GB/s — v5e ICI) and ``NXDI_TPU_DCN_GBPS`` (default 25
+GB/s; axes named by the ``parallel.mesh.Topology`` spec — by default
+``dp``, the outermost axis — are priced at DCN, everything else at
+ICI), upgrading the per-graph verdict to compute- vs memory- vs
+**comm**-bound — the regime EQuARX (PAPERS.md arxiv 2506.17615) shows
+dominates DCN-scale decode. The leg also reports ``comm_bytes_saved``:
+wire bytes the sub-fp32 payloads avoid relative to an fp32 exchange of
+the same shapes.
 
 Collectives censused inside a ``while``/``scan`` body are counted once
 (static census, not dynamic executions). On a single-device mesh the
@@ -77,6 +83,7 @@ import numpy as np
 
 from . import metrics as tmetrics
 from .registry import get_registry
+from ..parallel.mesh import Topology, topology_from_env
 
 __all__ = ["analyze_app", "census_collectives", "aggregate_census",
            "comm_roofline_seconds", "mesh_comm_labels",
@@ -194,15 +201,17 @@ _IOTA_GROUPS_RE = re.compile(
 _PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
 
 
-def _shape_bytes(type_str: str, async_start: bool = False) -> int:
-    """Byte size of an HLO result type. A sync tuple result (a variadic
-    combined collective) transfers EVERY element; an async ``-start``
-    tuple carries (operand..., result) where the earlier elements alias
-    inputs already counted — only the LAST element is the transferred
-    output."""
+def _shape_payload(type_str: str, async_start: bool = False
+                   ) -> Tuple[int, str, int]:
+    """(bytes, dtype, element count) of an HLO result type. A sync tuple
+    result (a variadic combined collective) transfers EVERY element; an
+    async ``-start`` tuple carries (operand..., result) where the earlier
+    elements alias inputs already counted — only the LAST element is the
+    transferred output. ``dtype`` is the first transferred shape's element
+    type token (variadic collectives are homogeneous in practice)."""
     shapes = _SHAPE_RE.findall(type_str)
     if not shapes:
-        return 0
+        return 0, "f32", 0
     if async_start:
         # legacy 4-element permute-start tuples trail u32[] context
         # scalars after the result — strip them before taking the last
@@ -211,13 +220,19 @@ def _shape_bytes(type_str: str, async_start: bool = False) -> int:
             shapes.pop()
         shapes = shapes[-1:]
     total = 0
+    elems = 0
     for dt, dims in shapes:
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
         total += n * _DTYPE_BYTES.get(dt, 4)
-    return total
+        elems += n
+    return total, shapes[0][0], elems
+
+
+def _shape_bytes(type_str: str, async_start: bool = False) -> int:
+    return _shape_payload(type_str, async_start)[0]
 
 
 def _parse_int_groups(body: str) -> List[Tuple[int, ...]]:
@@ -304,13 +319,15 @@ def _pairs_label(pairs: List[Tuple[int, ...]],
 def census_collectives(hlo_text: str, mesh=None) -> List[Dict[str, Any]]:
     """Census every collective op in post-SPMD optimized HLO text.
 
-    Returns one entry per op occurrence: ``{"kind", "comm", "bytes",
-    "group_size"}`` where ``kind`` is the op with underscores
-    (``all_reduce``…), ``comm`` names the mesh-axis subset the replica
-    groups ride (via :func:`mesh_comm_labels`; ``"unmapped"`` without a
-    mesh, ``"other"`` when groups match no axis subset) and ``bytes`` is
-    the op's result-tensor payload. Async ``-start``/``-done`` pairs are
-    counted once (at the start)."""
+    Returns one entry per op occurrence: ``{"kind", "comm", "dtype",
+    "bytes", "elems", "elem_bytes", "group_size"}`` where ``kind`` is the
+    op with underscores (``all_reduce``…), ``comm`` names the mesh-axis
+    subset the replica groups ride (via :func:`mesh_comm_labels`;
+    ``"unmapped"`` without a mesh, ``"other"`` when groups match no axis
+    subset), ``dtype`` is the wire payload element type (``f32``, ``s8``,
+    ``f8e4m3fn``…), ``bytes`` the op's result-tensor payload and
+    ``elems``/``elem_bytes`` its element count and per-element wire width.
+    Async ``-start``/``-done`` pairs are counted once (at the start)."""
     labels = mesh_comm_labels(mesh) if mesh is not None else None
     entries: List[Dict[str, Any]] = []
     for line in hlo_text.splitlines():
@@ -327,11 +344,15 @@ def census_collectives(hlo_text: str, mesh=None) -> List[Dict[str, Any]]:
             groups = _line_groups(line) or []
             comm = _groups_label(groups, labels) if groups else "other"
             group_size = max((len(g) for g in groups), default=1)
+        nbytes, dtype, elems = _shape_payload(m.group("type"),
+                                              m.group("suffix") == "-start")
         entries.append({
             "kind": kind.replace("-", "_"),
             "comm": comm,
-            "bytes": _shape_bytes(m.group("type"),
-                                  m.group("suffix") == "-start"),
+            "dtype": dtype,
+            "bytes": nbytes,
+            "elems": elems,
+            "elem_bytes": _DTYPE_BYTES.get(dtype, 4),
             "group_size": group_size,
         })
     return entries
@@ -339,11 +360,13 @@ def census_collectives(hlo_text: str, mesh=None) -> List[Dict[str, Any]]:
 
 def aggregate_census(entries: Sequence[Dict[str, Any]]
                      ) -> Dict[str, Dict[str, Any]]:
-    """Aggregate per-op census entries to ``{"kind@comm": {"count",
-    "bytes"}}`` — the shape the golden diff and the gauges key on."""
+    """Aggregate per-op census entries to ``{"kind@comm@dtype": {"count",
+    "bytes"}}`` — the shape the golden diff and the gauges key on. The
+    dtype leg makes quantized (s8/f8) wire payloads first-class: an int8
+    ring exchange and an fp32 all-reduce never fold into one bucket."""
     out: Dict[str, Dict[str, Any]] = {}
     for e in entries:
-        key = f"{e['kind']}@{e['comm']}"
+        key = f"{e['kind']}@{e['comm']}@{e.get('dtype', 'f32')}"
         slot = out.setdefault(key, {"count": 0, "bytes": 0})
         slot["count"] += 1
         slot["bytes"] += e["bytes"]
@@ -353,30 +376,56 @@ def aggregate_census(entries: Sequence[Dict[str, Any]]
 # ring-model wire-byte factors per collective kind: how many times the
 # result tensor's bytes cross the wire per participating device
 # (g = replica-group size)
-def _wire_bytes(entry: Dict[str, Any]) -> float:
-    g = max(entry["group_size"], 2)
-    b = float(entry["bytes"])
-    k = entry["kind"]
-    if k == "all_reduce":            # reduce-scatter + all-gather ring
-        return 2.0 * (g - 1) / g * b
-    if k == "reduce_scatter":        # result is the 1/g shard
-        return (g - 1) * b
-    if k == "collective_permute":
-        return b
+def _wire_factor(kind: str, group_size: int) -> float:
+    g = max(group_size, 2)
+    if kind == "all_reduce":         # reduce-scatter + all-gather ring
+        return 2.0 * (g - 1) / g
+    if kind == "reduce_scatter":     # result is the 1/g shard
+        return float(g - 1)
+    if kind == "collective_permute":
+        return 1.0
     # all_gather / all_to_all: result is the full tensor
-    return (g - 1) / g * b
+    return (g - 1) / g
+
+
+def _wire_bytes(entry: Dict[str, Any]) -> float:
+    # element byte-width comes from the CENSUS ENTRY — the op's actual
+    # wire payload dtype, not the graph dtype — so a quantized s8
+    # all-reduce prices at a quarter of the f32 one. Entries from older
+    # callers without the dtype leg fall back to their total bytes.
+    if "elems" in entry and "elem_bytes" in entry:
+        b = float(entry["elems"] * entry["elem_bytes"])
+    else:
+        b = float(entry["bytes"])
+    return _wire_factor(entry["kind"], entry["group_size"]) * b
+
+
+def _wire_bytes_saved(entry: Dict[str, Any]) -> float:
+    """Wire bytes this op avoids relative to an fp32 exchange of the same
+    shape — nonzero only for sub-fp32 *numeric* payloads (s8/u8/f8…), the
+    quantized-collective win. Bool masks (pred) are not savings."""
+    eb = entry.get("elem_bytes", 4)
+    if eb >= 4 or entry.get("dtype") == "pred" or "elems" not in entry:
+        return 0.0
+    return (_wire_factor(entry["kind"], entry["group_size"])
+            * entry["elems"] * (4 - eb))
 
 
 def comm_roofline_seconds(entries: Sequence[Dict[str, Any]],
-                          ici_gbps: float, dcn_gbps: float) -> float:
+                          ici_gbps: float, dcn_gbps: float,
+                          topology: Optional[Topology] = None) -> float:
     """Estimated wire time of one invocation's collectives under the
-    assumed link bandwidths (GB/s). ``dp``-axis traffic — the outermost,
-    DCN-friendly mesh axis — is priced at DCN bandwidth; every other
-    axis (and unmapped/other groups) rides ICI."""
+    assumed link bandwidths (GB/s). Traffic over axes the ``topology``
+    marks as DCN-crossing (default: :func:`~..parallel.mesh
+    .topology_from_env` — ``dp``, the outermost, DCN-friendly mesh axis)
+    is priced at DCN bandwidth; every other axis (and unmapped/other
+    groups) rides ICI."""
+    if topology is None:
+        topology = topology_from_env()
     total = 0.0
     for e in entries:
         axes = set(e["comm"].split("+"))
-        bw = dcn_gbps if "dp" in axes else ici_gbps
+        bw = dcn_gbps if topology.is_dcn(axes) else ici_gbps
         if bw > 0:
             total += _wire_bytes(e) / (bw * 1e9)
     return total
@@ -587,6 +636,8 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
             t_memory = bytes_acc / (hbm_gbps * 1e9)
             t_comm = (comm_roofline_seconds(census, ici_gbps, dcn_gbps)
                       if census else 0.0)
+            saved = (sum(_wire_bytes_saved(e) for e in census)
+                     if census else 0.0)
             legs = {"compute": t_compute, "memory": t_memory,
                     "comm": t_comm}
             bound = max(legs, key=legs.get)
@@ -596,6 +647,9 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
                 "t_compute_ms": round(t_compute * 1e3, 6),
                 "t_memory_ms": round(t_memory * 1e3, 6),
                 "t_comm_ms": round(t_comm * 1e3, 6),
+                # wire bytes the quantized (sub-fp32) payloads avoid vs
+                # an fp32 exchange of the same shapes — 0 on fp32 graphs
+                "comm_bytes_saved": int(round(saved)),
             }
         graph: Dict[str, Any] = {
             "kind": kind,
@@ -626,13 +680,14 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
                                                      bucket=bucket)
     if reg.enabled:
         # collective census gauges aggregate over the app's whole graph
-        # set — kind here is the COLLECTIVE kind, comm the mesh-axis group
+        # set — kind here is the COLLECTIVE kind, comm the mesh-axis
+        # group, dtype the wire payload element type
         coll_g = tmetrics.graph_collectives_gauge(reg)
         bytes_g = tmetrics.graph_collective_bytes_gauge(reg)
         for key, slot in aggregate_census(app_census).items():
-            ckind, comm = key.split("@", 1)
-            coll_g.set(slot["count"], kind=ckind, comm=comm)
-            bytes_g.set(slot["bytes"], kind=ckind, comm=comm)
+            ckind, comm, dtype = key.split("@", 2)
+            coll_g.set(slot["count"], kind=ckind, comm=comm, dtype=dtype)
+            bytes_g.set(slot["bytes"], kind=ckind, comm=comm, dtype=dtype)
     return {
         "schema": GRAPH_REPORT_SCHEMA,
         "backend": jax.default_backend(),
@@ -652,5 +707,7 @@ def analyze_app(app, registry=None, hbm_gbps: Optional[float] = None,
             "bytes_accessed": sum(g["bytes_accessed"] for g in graphs),
             "collectives": len(app_census),
             "collective_bytes": sum(e["bytes"] for e in app_census),
+            "comm_bytes_saved": int(round(sum(
+                _wire_bytes_saved(e) for e in app_census))),
         },
     }

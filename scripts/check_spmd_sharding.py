@@ -40,6 +40,12 @@ plumbing; all CPU-mesh compiles, no execution):
   * ``paged_ragged_dp2tp2`` — the ragged UNIFIED mixed
     prefill+decode+verify dispatch (serving/ragged/,
     ``model_base.paged_ragged_step``) at the same W=4
+  * ``cb_decode_int8_dp2tp2`` / ``paged_decode_fp8_dp2tp2`` — the same
+    decode steps with ``CollectiveConfig`` quantized collectives (int8 /
+    fp8 wire payloads): the row-parallel output all-reduces lower to
+    s8/f8 ppermute rings, and the golden pins the wire-byte reduction
+    (the census keys carry the payload dtype, so an accidental fall-back
+    to fp32 collectives is a red diff, not a silent 4x wire regression)
 
 Usage::
 
@@ -198,13 +204,15 @@ def _entry_graph(moe: bool):
     return mesh, fn, args, {}
 
 
-_APP_CACHE: Dict[bool, Any] = {}
+_APP_CACHE: Dict[Tuple[bool, Optional[str]], Any] = {}
 
 
-def _serving_app(paged: bool):
-    if paged in _APP_CACHE:       # paged serves two pinned graphs — one
-        return _APP_CACHE[paged]  # weights+cache init, not one per graph
-    from neuronx_distributed_inference_tpu.config import TpuConfig
+def _serving_app(paged: bool, collective_dtype: Optional[str] = None):
+    key = (paged, collective_dtype)
+    if key in _APP_CACHE:         # each app serves several pinned graphs
+        return _APP_CACHE[key]    # — one weights+cache init per config
+    from neuronx_distributed_inference_tpu.config import (CollectiveConfig,
+                                                          TpuConfig)
     from neuronx_distributed_inference_tpu.models.application import (
         CausalLMApplication, PagedCausalLMApplication)
     from neuronx_distributed_inference_tpu.models.llama import (
@@ -214,6 +222,8 @@ def _serving_app(paged: bool):
     extra = ({"is_block_kv_layout": True, "pa_block_size": 16,
               "is_prefix_caching": True}
              if paged else {"is_continuous_batching": True})
+    if collective_dtype is not None:
+        extra["collective_config"] = CollectiveConfig(dtype=collective_dtype)
     tcfg = TpuConfig(batch_size=2, seq_len=128, dtype="float32",
                      enable_bucketing=True, context_encoding_buckets=[16],
                      decode_chunk_tokens=4, tp_degree=4,
@@ -223,12 +233,13 @@ def _serving_app(paged: bool):
     app = cls(None, LlamaInferenceConfig(tcfg, **_tiny_hf()), LlamaFamily,
               mesh=mesh)
     app.init_random_weights(seed=0).init_cache()
-    return _APP_CACHE.setdefault(paged, app)
+    return _APP_CACHE.setdefault(key, app)
 
 
-def _app_graph(paged: bool, kind: str):
+def _app_graph(paged: bool, kind: str,
+               collective_dtype: Optional[str] = None):
     from neuronx_distributed_inference_tpu.telemetry import observatory
-    app = _serving_app(paged)
+    app = _serving_app(paged, collective_dtype)
     for k, bucket, build in observatory._graph_entries(app):
         if k == kind:
             fn, args, kwargs = build()
@@ -245,6 +256,11 @@ PINNED: Dict[str, Any] = {
     "cb_decode_dp2tp2": lambda: _app_graph(False, "decode"),
     "paged_spec_verify_dp2tp2": lambda: _app_graph(True, "spec_verify"),
     "paged_ragged_dp2tp2": lambda: _app_graph(True, "ragged"),
+    # quantized-collective decode graphs (EQuARX-style s8/f8 ppermute
+    # rings replacing the row-parallel fp32 all-reduces) — the dtype leg
+    # of the census keys pins the wire-byte reduction
+    "cb_decode_int8_dp2tp2": lambda: _app_graph(False, "decode", "int8"),
+    "paged_decode_fp8_dp2tp2": lambda: _app_graph(True, "paged", "fp8"),
 }
 
 

@@ -71,12 +71,13 @@ def test_mesh_census_collectives_and_comm_roofline(mesh_report):
     for g in report["graphs"]:
         assert g["collective_count"] > 0 and g["collective_bytes"] > 0
         for key, slot in g["collectives"].items():
-            ckind, comm = key.split("@")
+            ckind, comm, dtype = key.split("@")
             assert ckind in ("all_reduce", "all_gather", "reduce_scatter",
                              "collective_permute", "all_to_all")
             # every comm group maps back to real mesh axes — nothing
             # "other"/"unmapped" on the serving graphs
             assert set(comm.split("+")) <= {"dp", "tp"}, key
+            assert dtype, key                  # dtype leg always present
             assert slot["count"] > 0 and slot["bytes"] >= 0
         rl = g["roofline"]
         assert rl["bound"] in ("compute", "memory", "comm")
@@ -85,7 +86,7 @@ def test_mesh_census_collectives_and_comm_roofline(mesh_report):
                                         rl["t_memory_ms"], rl["t_comm_ms"])
     # the decode step moves tp all-reduces (row-parallel matmul psums)
     w1 = next(g for g in report["graphs"] if g["bucket"] == "w1xb2")
-    assert w1["collectives"]["all_reduce@tp"]["count"] > 0
+    assert w1["collectives"]["all_reduce@tp@f32"]["count"] > 0
     assert report["totals"]["collective_bytes"] > 0
     json.dumps(report)                              # artifact-ready
 
@@ -93,9 +94,9 @@ def test_mesh_census_collectives_and_comm_roofline(mesh_report):
 def test_mesh_census_gauges(mesh_report):
     _, reg = mesh_report
     assert reg.get(tmetrics.GRAPH_COLLECTIVES_TOTAL).get(
-        kind="all_reduce", comm="tp") > 0
+        kind="all_reduce", comm="tp", dtype="f32") > 0
     assert reg.get(tmetrics.GRAPH_COLLECTIVE_BYTES).get(
-        kind="all_gather", comm="dp") > 0
+        kind="all_gather", comm="dp", dtype="f32") > 0
 
 
 def test_comm_roofline_prices_dp_at_dcn():
@@ -170,18 +171,20 @@ def test_census_parser_doctored_hlo():
     entries = observatory.census_collectives(DOCTORED_HLO, mesh)
     agg = observatory.aggregate_census(entries)
     # async pair counted once, at the -start
-    assert agg["all_gather@dp"]["count"] == 1
+    assert agg["all_gather@dp@f32"]["count"] == 1
     # the sync VARIADIC combiner (tuple result) transfers every element:
     # one plain all-reduce (128B) + one 2-way combined (2 x 128B)
-    assert agg["all_reduce@tp"] == {"count": 2, "bytes": 3 * 4 * 8 * 4}
+    assert agg["all_reduce@tp@f32"] == {"count": 2, "bytes": 3 * 4 * 8 * 4}
     # iota groups [2,2]<=[4] = rows {0,1},{2,3} = tp
-    assert agg["all_gather@tp"] == {"count": 1, "bytes": 8 * 8 * 4}
+    assert agg["all_gather@tp@f32"] == {"count": 1, "bytes": 8 * 8 * 4}
     # -start result tuple: LAST element (the gathered output) is counted
-    assert agg["all_gather@dp"]["bytes"] == 8 * 8 * 4
-    # permute pairs stay inside tp groups; bf16 sized at 2 bytes, and
-    # the transposed iota [2,2]<=[2,2]T(1,0) = columns {0,2},{1,3} = dp
-    assert agg["collective_permute@tp"] == {"count": 1, "bytes": 4 * 8 * 4}
-    assert agg["reduce_scatter@dp"] == {"count": 1, "bytes": 2 * 8 * 2}
+    assert agg["all_gather@dp@f32"]["bytes"] == 8 * 8 * 4
+    # permute pairs stay inside tp groups; bf16 keys its OWN dtype bucket
+    # sized at 2 bytes, and the transposed iota [2,2]<=[2,2]T(1,0) =
+    # columns {0,2},{1,3} = dp
+    assert agg["collective_permute@tp@f32"] == {"count": 1,
+                                                "bytes": 4 * 8 * 4}
+    assert agg["reduce_scatter@dp@bf16"] == {"count": 1, "bytes": 2 * 8 * 2}
     # without a mesh the kinds/bytes still parse, comm is unmapped
     assert all(e["comm"] == "unmapped"
                for e in observatory.census_collectives(DOCTORED_HLO))
