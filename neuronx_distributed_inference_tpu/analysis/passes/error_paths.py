@@ -37,6 +37,7 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/fleet/autoscaler.py",
     "neuronx_distributed_inference_tpu/serving/fleet/loadgen.py",
     "neuronx_distributed_inference_tpu/modules/block_kv_cache.py",
+    "neuronx_distributed_inference_tpu/modules/low_rank.py",
     "neuronx_distributed_inference_tpu/parallel/collectives.py",
     "neuronx_distributed_inference_tpu/resilience/controller.py",
     "neuronx_distributed_inference_tpu/resilience/chaos.py",

@@ -56,6 +56,10 @@ REGION_PATHS = (
     # (the shard_map ring bodies are traced regions too)
     "neuronx_distributed_inference_tpu/parallel/layers.py",
     "neuronx_distributed_inference_tpu/parallel/collectives.py",
+    # sampled-verify call chain: model_base.paged_spec_verify /
+    # paged_ragged_step -> sampling_ops.coupled_sample / stream_keys
+    # (the coupled gumbel draws trace inside every decode graph)
+    "neuronx_distributed_inference_tpu/ops/sampling.py",
 ) + JIT_SITE_PATHS
 
 CONFIG_PARAM_NAMES = {"self", "spec", "cfg", "config", "tpu_cfg",

@@ -69,6 +69,13 @@ class OnDeviceSamplingConfig:
     deterministic: bool = False
     global_topk: int = 256        # stage-1 topk width for hierarchical top-k
     on_device: bool = True
+    # Positionally coupled streams (ops/sampling.coupled_sample): every
+    # draw keyed by (stream_seed, request seed, absolute position), so
+    # sampled streams are reproducible and path-invariant — the knob
+    # that unlocks sampled speculation / ragged serving (README
+    # "Sampled speculation & compressed decode"). None = per-dispatch
+    # rng (legacy; refused under speculation).
+    stream_seed: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -336,6 +343,11 @@ class TpuConfig:
     # --- quantized decode collectives (parallel/collectives.py) ---
     collective_config: Optional[CollectiveConfig] = None
 
+    # --- low-rank (SVD-compressed) decode MLP (modules/low_rank.py,
+    # NeuronMLP arxiv 2510.25977): factorize gate/up/down into rank-r
+    # (U, V) pairs host-side; None = dense ---
+    mlp_low_rank: Optional[int] = None
+
     # --- kernels (reference: models/config.py:417-567 — ~25 enable flags) ---
     # None/False = XLA attention path (measured faster than the v1 Pallas
     # kernel on v5e); True = opt into the Pallas flash prefill kernel where
@@ -442,6 +454,20 @@ class TpuConfig:
             if cc.block < 1:
                 raise ConfigurationError(
                     "collective_config.block must be >= 1")
+        if self.mlp_low_rank is not None:
+            from .resilience.errors import ConfigurationError
+            if self.mlp_low_rank < 1:
+                raise ConfigurationError(
+                    f"mlp_low_rank must be >= 1, got {self.mlp_low_rank} "
+                    "(None disables the low-rank MLP)")
+        sc = self.on_device_sampling_config
+        if sc is not None and sc.stream_seed is not None \
+                and not sc.do_sample:
+            from .resilience.errors import ConfigurationError
+            raise ConfigurationError(
+                "on_device_sampling_config.stream_seed requires "
+                "do_sample=True: coupled streams only exist for sampled "
+                "decode (greedy is already deterministic)")
 
     # -- dtype helpers --
     @property

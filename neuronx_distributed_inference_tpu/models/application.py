@@ -115,7 +115,7 @@ class CausalLMApplication:
     def init_random_weights(self, seed: int = 0):
         """Synthetic weights (tiny-model tests / benches — reference:
         modules/checkpoint.py:202-287)."""
-        if self.spec.quant is None:
+        if self.spec.quant is None and self.spec.low_rank is None:
             self.params = model_base.init_params(
                 self.spec, jax.random.PRNGKey(seed), self.mesh)
         else:
@@ -125,12 +125,15 @@ class CausalLMApplication:
         return self
 
     def _put_params(self, host: Dict[str, Any]):
-        """Shard-on-load; quantize first when the config asks for it
-        (reference: application_base.py:746-799 quantize-and-save path)."""
+        """Shard-on-load; factorize (SVD) and/or quantize first when the
+        config asks for it (reference: application_base.py:746-799
+        quantize-and-save path). Order matters: the SVD needs the fp
+        weight, so low-rank factorization runs BEFORE quantization and
+        quantizes its own factors (modules/low_rank.factorize_params)."""
         from ..modules import quantization as quant
         host = model_base.fuse_qkv_host(host)
         fp_shardings = model_base.param_shardings(self.spec, self.mesh)
-        if self.spec.quant is None:
+        if self.spec.quant is None and self.spec.low_rank is None:
             self.params = ckpt.device_put_params(host, fp_shardings,
                                                  dtype=self.spec.dtype)
             return
@@ -138,9 +141,14 @@ class CausalLMApplication:
             lambda x: (np.asarray(x).astype(self.spec.dtype)
                        if np.issubdtype(np.asarray(x).dtype, np.floating)
                        else np.asarray(x)), host)
-        qhost = quant.quantize_params(host, self.spec.quant)
-        shardings = quant.quantized_shardings(fp_shardings, qhost, self.mesh)
-        self.params = ckpt.device_put_params(qhost, shardings, dtype=None)
+        if self.spec.low_rank is not None:
+            from ..modules import low_rank as low_rank_mod
+            host = low_rank_mod.factorize_params(
+                host, self.spec.low_rank, quant=self.spec.quant)
+        if self.spec.quant is not None:
+            host = quant.quantize_params(host, self.spec.quant)
+        shardings = quant.quantized_shardings(fp_shardings, host, self.mesh)
+        self.params = ckpt.device_put_params(host, shardings, dtype=None)
 
     def save_quantized_state_dict(self, path: str):
         """Quantize the loaded/initialized weights and save them flat
@@ -1129,13 +1137,32 @@ class PagedCausalLMApplication(CausalLMApplication):
         fn = partial(model_base.paged_forward_step, self.spec, self.tpu_config)
         return jax.jit(fn, donate_argnums=(1,))
 
+    # -- positionally coupled sampling (ops/sampling.coupled_sample) -------
+    def _coupled_sampling(self) -> bool:
+        sc = self.tpu_config.on_device_sampling_config
+        return (sc is not None and sc.do_sample
+                and sc.stream_seed is not None)
+
+    def _stream_seeds(self, row_seeds, batch: int):
+        """Gate the per-row seed input of the paged graph family: None
+        unless the coupled stream is on (an absent optional arg is an
+        empty pytree, so off-knob graphs stay byte-identical). The
+        serving adapters always thread their per-request seeds; this
+        gate is what keeps greedy configs on the legacy graphs."""
+        if not self._coupled_sampling():
+            return None
+        if row_seeds is None:
+            return jnp.zeros((batch,), jnp.int32)
+        return jnp.asarray(row_seeds, jnp.int32)
+
     def _jit_paged_loop(self, num_steps: int):
         fn = partial(model_base.paged_decode_loop, self.spec, self.tpu_config,
                      num_steps=num_steps)
         return jax.jit(fn, donate_argnums=(1,))
 
     def _run_paged_loop(self, first_tokens, positions, block_table,
-                        num_steps: int, sampling_params=None):
+                        num_steps: int, sampling_params=None,
+                        row_seeds=None):
         # horizon guard: the fused loop writes KV at positions
         # [p, p+num_steps); past seq_len the in-graph slot advance would
         # index past the block table (mirrors _run_decode_loop's guard)
@@ -1150,11 +1177,13 @@ class PagedCausalLMApplication(CausalLMApplication):
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 first_tokens.shape[0])
+        seeds = self._stream_seeds(row_seeds, first_tokens.shape[0])
+        kw = {"row_seeds": seeds} if seeds is not None else {}
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(first_tokens),
                 jnp.asarray(positions), jnp.asarray(block_table),
-                sampling_params, self._next_rng())
+                sampling_params, self._next_rng(), **kw)
         self.cache = out["cache"]
         self._tel_end("paged_loop", t0, out,
                       first_tokens.shape[0] * num_steps)
@@ -1180,7 +1209,8 @@ class PagedCausalLMApplication(CausalLMApplication):
         return jax.jit(fn, donate_argnums=(1,))
 
     def _run_spec_draft(self, first_tokens, positions, block_table, widths,
-                        num_steps: int, sampling_params=None):
+                        num_steps: int, sampling_params=None,
+                        row_seeds=None):
         """Masked greedy-k self-draft pass (one fused dispatch; see
         model_base.paged_spec_draft_loop). Frozen rows (width already
         reached) write nothing, so the per-row clamp in ``widths`` bounds
@@ -1196,22 +1226,27 @@ class PagedCausalLMApplication(CausalLMApplication):
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 first_tokens.shape[0])
+        seeds = self._stream_seeds(row_seeds, first_tokens.shape[0])
+        kw = {"row_seeds": seeds} if seeds is not None else {}
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(first_tokens),
                 jnp.asarray(positions), jnp.asarray(block_table),
-                jnp.asarray(widths), sampling_params, self._next_rng())
+                jnp.asarray(widths), sampling_params, self._next_rng(),
+                **kw)
         self.cache = out["cache"]
         self._tel_end("spec_draft", t0, out,
                       first_tokens.shape[0] * num_steps)
         return out
 
     def _run_spec_verify(self, input_ids, position_ids, slot_mapping,
-                         block_table, widths, want_hidden: bool = False):
+                         block_table, widths, want_hidden: bool = False,
+                         sampling_params=None, row_seeds=None):
         """Speculative verify dispatch: ONE ragged k+1-wide paged forward
-        with in-graph greedy acceptance (model_base.paged_spec_verify).
-        ``input_ids`` may be a device array — drafts never round-trip
-        through the host."""
+        with in-graph exact-match acceptance (model_base.paged_spec_verify
+        — greedy argmax, or the coupled sampled draw when the stream-seed
+        knob is on). ``input_ids`` may be a device array — drafts never
+        round-trip through the host."""
         self._check_decode_fits(
             int(np.max(np.asarray(position_ids)[:, 0]
                        + np.asarray(widths))))
@@ -1221,11 +1256,18 @@ class PagedCausalLMApplication(CausalLMApplication):
             self._compiled[key] = self._jit_spec_verify(want_hidden)
         self._note_jit("spec_verify", input_ids.shape[1],
                        (input_ids.shape, block_table.shape))
+        seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
+        kw = {}
+        if seeds is not None:
+            if sampling_params is None:
+                sampling_params = self._default_sampling_params(
+                    input_ids.shape[0])
+            kw = {"sampling_params": sampling_params, "row_seeds": seeds}
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(input_ids),
                 jnp.asarray(position_ids), jnp.asarray(slot_mapping),
-                jnp.asarray(block_table), jnp.asarray(widths))
+                jnp.asarray(block_table), jnp.asarray(widths), **kw)
         self.cache = out["cache"]
         self._tel_end("spec_verify", t0, out, input_ids.shape[0])
         return out
@@ -1238,7 +1280,8 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_ragged(self, input_ids, position_ids, slot_mapping,
                     block_table, widths, emit_modes,
-                    want_hidden: bool = False, sampling_params=None):
+                    want_hidden: bool = False, sampling_params=None,
+                    row_seeds=None):
         """ONE ragged mixed dispatch (model_base.paged_ragged_step): rows
         mix decode steps, prefill chunks and speculative verify windows,
         each at its own offset over its own block table. ``input_ids``
@@ -1256,12 +1299,15 @@ class PagedCausalLMApplication(CausalLMApplication):
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 input_ids.shape[0])
+        seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
+        kw = {"row_seeds": seeds} if seeds is not None else {}
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(input_ids),
                 jnp.asarray(position_ids), jnp.asarray(slot_mapping),
                 jnp.asarray(block_table), jnp.asarray(widths),
-                jnp.asarray(emit_modes), sampling_params, self._next_rng())
+                jnp.asarray(emit_modes), sampling_params, self._next_rng(),
+                **kw)
         self.cache = out["cache"]
         self._tel_end("ragged", t0, out, input_ids.shape[0])
         return out
@@ -1279,7 +1325,7 @@ class PagedCausalLMApplication(CausalLMApplication):
                                                kind="block_table")
 
     def _run_paged(self, input_ids, position_ids, slot_mapping, block_table,
-                   last_idx, sampling_params=None):
+                   last_idx, sampling_params=None, row_seeds=None):
         t0 = self._tel_start()
         fn = self.get_compiled("paged_forward")
         # one jitted graph serves every paged call; the shape signature
@@ -1288,11 +1334,13 @@ class PagedCausalLMApplication(CausalLMApplication):
                        (input_ids.shape, block_table.shape))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(input_ids.shape[0])
+        seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
+        kw = {"row_seeds": seeds} if seeds is not None else {}
         with self._mesh_ctx():
             out = fn(self.params, self.cache, jnp.asarray(input_ids),
                      jnp.asarray(position_ids), jnp.asarray(slot_mapping),
                      jnp.asarray(block_table), jnp.asarray(last_idx),
-                     sampling_params, self._next_rng())
+                     sampling_params, self._next_rng(), **kw)
         self.cache = out["cache"]
         self._tel_end("paged", t0, out, input_ids.shape[0])
         return out

@@ -53,6 +53,7 @@ from ..parallel.layers import (GQASharding, ParamSpec, column_parallel,
 from ..parallel.mesh import (AXIS_CP, AXIS_DP, AXIS_EP, AXIS_MP, AXIS_TP,
                              shard_constraint as _shard)
 from ..modules import kv_cache as kv
+from ..modules import low_rank as low_rank_mod
 from ..modules import ssm as ssm_mod
 from ..modules.moe import MoESpec, moe_block
 from ..modules.lora import (LoraSpec, apply_lora, lora_spec_from_config)
@@ -283,6 +284,10 @@ class DecoderSpec:
     # the fp32 collective — its reduction is amortized over the whole prompt.
     collective_dtype: Optional[str] = None
     collective_block: int = 32
+    # low-rank (SVD-compressed) MLP (modules/low_rank.py, NeuronMLP
+    # arxiv 2510.25977): rank of the {"lr_u","lr_v"} factor pairs the
+    # gate/up/down projections are compressed to host-side; None = dense
+    low_rank: Optional[low_rank_mod.LowRankSpec] = None
     # --- recurrent / hybrid state axis (reference: contrib/models/
     # Falcon-H1-0.5B-Instruct hybrid attention+mamba2 and contrib/models/
     # recurrentgemma-2b-it Griffin blocks — a SECOND cache pytree of
@@ -847,6 +852,13 @@ def _row_parallel_out(spec: DecoderSpec, x, w, phase: str):
     ("paged" covers the whole paged serving family including its context
     graphs — the unified ragged dispatch mixes both in one step), otherwise
     the plain (q)linear whose all-reduce GSPMD inserts."""
+    if isinstance(w, dict) and "lr_u" in w:
+        # low-rank (SVD) factors (modules/low_rank.py): the sharded
+        # x @ U contraction's all-reduce lands on the rank-r
+        # intermediate — already an ~out/r smaller wire than the dense
+        # output — so the quantized ring is skipped; GSPMD reduces the
+        # U half and the replicated V half needs no collective
+        return qlinear(x, w)
     if spec.collective_dtype is not None and phase in ("decode", "paged"):
         return row_parallel_output(x, w,
                                    collective_dtype=spec.collective_dtype,
@@ -1838,9 +1850,19 @@ def token_generation_multi(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
             "hidden": hidden}
 
 
+def _coupled_mode(tpu_cfg: TpuConfig, row_seeds) -> bool:
+    """True when the positionally coupled sampling stream is active:
+    the config opts in (``do_sample`` + ``stream_seed``) AND the caller
+    threaded per-row seeds. ``row_seeds=None`` keeps the legacy graphs
+    byte-identical (an absent optional arg is an empty pytree)."""
+    sc = tpu_cfg.on_device_sampling_config
+    return (row_seeds is not None and sc is not None and sc.do_sample
+            and sc.stream_seed is not None)
+
+
 def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                        input_ids, position_ids, slot_mapping, block_table,
-                       last_idx, sampling_params, rng):
+                       last_idx, sampling_params, rng, row_seeds=None):
     """Unified paged-KV step graph (reference:
     modules/kvcache/block_kv_cache_manager.py + the prefix-caching prefill of
     attention_base.py:772-914). One graph covers:
@@ -1854,6 +1876,11 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     slot_mapping (B, T) flat cache slots (negative = drop);
     block_table (B, max_blocks); last_idx (B,) index into T of the token whose
     logits are sampled. Cache layout (L, N_blocks, Bs, Hkv, D).
+    row_seeds (B,) optional per-request sampling seeds: when present and
+    the config carries ``stream_seed``, sampling switches to the
+    positionally coupled draw (``ops/sampling.coupled_sample``) keyed by
+    the ABSOLUTE position of the sampled token — the invariant every
+    sampled-speculation bit-identity guarantee rests on.
     """
     kv_len = block_table.shape[1] * cache["k"].shape[2]
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
@@ -1868,8 +1895,17 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     out = {"cache": new_cache}
     if tpu_cfg.output_logits:
         out["logits"] = _lm_head(spec, params, hidden)[..., :spec.vocab_size]
-    out["tokens"] = sampling_ops.sample_dp(
-        logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
+    if _coupled_mode(tpu_cfg, row_seeds):
+        # position of the sampled token = the last real input position
+        pos_last = jnp.take_along_axis(
+            position_ids, last_idx[:, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        out["tokens"] = sampling_ops.coupled_sample(
+            logits, tpu_cfg.on_device_sampling_config, sampling_params,
+            row_seeds, pos_last)
+    else:
+        out["tokens"] = sampling_ops.sample_dp(
+            logits, tpu_cfg.on_device_sampling_config, sampling_params, rng)
     return out
 
 
@@ -1994,7 +2030,7 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                      first_tokens, position_ids, block_table,
-                     sampling_params, rng, num_steps: int):
+                     sampling_params, rng, num_steps: int, row_seeds=None):
     """Fused multi-token PAGED decode: ``num_steps`` steps in one device
     call with ZERO per-token host work — slot mappings are computed
     IN-GRAPH from the (pre-extended) block tables, exactly the reference's
@@ -2014,7 +2050,8 @@ def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         out = paged_forward_step(
             spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
             pos[:, None], slot[:, None], block_table,
-            jnp.zeros((b,), jnp.int32), sampling_params, step_rng)
+            jnp.zeros((b,), jnp.int32), sampling_params, step_rng,
+            row_seeds=row_seeds)
         return (out["tokens"], pos + 1, out["cache"]), out["tokens"]
 
     rngs = jax.random.split(rng, num_steps)
@@ -2025,7 +2062,8 @@ def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                           cache, first_tokens, position_ids, block_table,
-                          widths, sampling_params, rng, num_steps: int):
+                          widths, sampling_params, rng, num_steps: int,
+                          row_seeds=None):
     """Masked greedy-k SELF-DRAFT loop over the paged cache — the
     always-available proposer of speculative serving (serving/speculation/):
     the target model drafts its own continuation through ``num_steps``
@@ -2059,7 +2097,8 @@ def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
         out = paged_forward_step(
             spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
             pos[:, None], slot[:, None], block_table,
-            jnp.zeros((b,), jnp.int32), sampling_params, step_rng)
+            jnp.zeros((b,), jnp.int32), sampling_params, step_rng,
+            row_seeds=row_seeds)
         ntok = jnp.where(valid, out["tokens"], tok)
         npos = jnp.where(valid, pos + 1, pos)
         return (ntok, npos, out["cache"]), ntok
@@ -2073,7 +2112,8 @@ def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
 
 def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                       input_ids, position_ids, slot_mapping, block_table,
-                      widths, want_hidden: bool = False):
+                      widths, sampling_params=None, row_seeds=None,
+                      want_hidden: bool = False):
     """Speculative VERIFY graph over the paged layout: score all candidate
     positions in ONE ragged multi-token dispatch and compute greedy
     acceptance in-graph (reference acceptance: the cumsum-of-mismatch
@@ -2087,12 +2127,22 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     with columns >= the row's width at -1 (dropped writes); widths (B,)
     per-row candidate counts in [1, W].
 
-    Greedy exact-match acceptance: draft j is accepted iff it equals the
-    target's greedy choice at the previous candidate position; one bonus
-    token (the target's correction at the first mismatch) is always
-    emitted, so ``num_emitted`` is in [1, width]. The emitted tokens ARE
-    the target's greedy choices at consecutive positions — identical to
-    what eager decode would produce, whatever the draft quality.
+    Exact-match acceptance: draft j is accepted iff it equals the
+    target's choice at the previous candidate position; one bonus token
+    (the target's correction at the first mismatch) is always emitted,
+    so ``num_emitted`` is in [1, width]. The emitted tokens ARE the
+    target's choices at consecutive positions — identical to what eager
+    decode would produce, whatever the draft quality.
+
+    Under greedy the target choice is the argmax. Under the coupled
+    sampled stream (``sampling_params``/``row_seeds`` threaded and the
+    config carrying ``stream_seed``) it is the gumbel-coupled draw of
+    ``ops/sampling.coupled_sample`` — the in-graph uniform (gumbel)
+    variates are keyed by absolute position, so the ratio test of
+    classic rejection sampling reduces to exact match under the shared
+    noise: acceptance means the draft equals the token eager sampled
+    decode would have drawn, and the bonus token is the coupled residual
+    resample. Output distribution AND stream are preserved.
 
     Returns tokens (B, W) (emitted prefix, 0 past ``num_emitted``),
     num_emitted (B,), cache (+ hidden (B, W, H) when ``want_hidden`` —
@@ -2110,23 +2160,30 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         spec, params, cache, hidden, ai, None, position_ids,
         "paged", slot_mapping=slot_mapping, block_table=block_table)
     logits = _lm_head(spec, params, hidden)
-    # the same greedy the eager paged step applies (sampling_ops.sample
-    # over the untruncated head output) — bit-identity depends on it
-    greedy = sampling_ops.sample(logits, None, None, None)      # (B, W)
+    if _coupled_mode(tpu_cfg, row_seeds):
+        # the same coupled draw the eager paged step applies at each
+        # position — bit-identity depends on it
+        target = sampling_ops.coupled_sample(
+            logits, tpu_cfg.on_device_sampling_config, sampling_params,
+            row_seeds, position_ids)                            # (B, W)
+    else:
+        # the same greedy the eager paged step applies
+        # (sampling_ops.sample over the untruncated head output)
+        target = sampling_ops.sample(logits, None, None, None)  # (B, W)
     b, w = input_ids.shape
     idx = jnp.arange(w, dtype=jnp.int32)[None, :]
     if w > 1:
-        # draft j (column j+1) must match the greedy choice at column j;
+        # draft j (column j+1) must match the target choice at column j;
         # columns past the row's width are forced mismatches so a padded
         # row can never accept into its neighbour's padding
-        mismatch = ((input_ids[:, 1:] != greedy[:, :-1])
+        mismatch = ((input_ids[:, 1:] != target[:, :-1])
                     | (idx[:, 1:] >= widths[:, None])).astype(jnp.int32)
         n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)
     else:
         n_acc = jnp.zeros((b,), jnp.int32)
-    # accepted drafts equal the greedy choices by construction, so the
-    # emitted prefix is simply greedy[:, :n_acc+1] (bonus included)
-    tokens = jnp.where(idx <= n_acc[:, None], greedy, 0)
+    # accepted drafts equal the target choices by construction, so the
+    # emitted prefix is simply target[:, :n_acc+1] (bonus included)
+    tokens = jnp.where(idx <= n_acc[:, None], target, 0)
     out = {"tokens": tokens, "num_emitted": n_acc + 1, "cache": new_cache}
     if want_hidden:
         out["hidden"] = hidden
@@ -2136,7 +2193,7 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                       input_ids, position_ids, slot_mapping, block_table,
                       widths, emit_modes, sampling_params, rng,
-                      want_hidden: bool = False):
+                      row_seeds=None, want_hidden: bool = False):
     """The RAGGED UNIFIED dispatch: ONE mixed paged forward whose rows mix
     decode steps (width 1), prefill chunks (width n, positions at the
     row's own suffix offset) and speculative verify windows (width k+1)
@@ -2159,13 +2216,17 @@ def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         step; FINAL prefill chunk): the same ``sample_dp`` over the
         gathered last-position logits the eager paged step applies, so
         streams are bit-identical to :func:`paged_forward_step`.
-      * 2 — greedy exact-match acceptance over the candidate window
+      * 2 — exact-match acceptance over the candidate window
         (speculative verify): identical math to
         :func:`paged_spec_verify` — draft j accepted iff it equals the
-        target's greedy choice at the previous candidate position,
-        columns past the row's width forced mismatches, one bonus token
-        always emitted, so ``num_emitted`` is in [1, width] and the
-        emitted tokens ARE the target's greedy choices.
+        target's choice at the previous candidate position, columns past
+        the row's width forced mismatches, one bonus token always
+        emitted, so ``num_emitted`` is in [1, width] and the emitted
+        tokens ARE the target's choices (greedy argmax, or the
+        gumbel-coupled sampled draw when ``row_seeds`` is threaded and
+        the config carries ``stream_seed`` — see
+        :func:`paged_spec_verify` for why exact match IS rejection
+        sampling under the shared positional noise).
 
     Returns tokens (B, W) (emitted prefix, 0 past ``num_emitted``),
     num_emitted (B,), cache (+ hidden (B, W, H) when ``want_hidden`` —
@@ -2183,32 +2244,45 @@ def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         spec, params, cache, hidden, ai, None, position_ids,
         "paged", slot_mapping=slot_mapping, block_table=block_table)
     logits = _lm_head(spec, params, hidden)
-    # verify-row acceptance: the same greedy the eager paged step applies
-    # (sampling_ops.sample over the untruncated head output)
-    greedy = sampling_ops.sample(logits, None, None, None)      # (B, W)
+    coupled = _coupled_mode(tpu_cfg, row_seeds)
+    if coupled:
+        # verify-row acceptance AND emit-last sampling from the SAME
+        # coupled draws the eager paged step applies at each position
+        target = sampling_ops.coupled_sample(
+            logits, tpu_cfg.on_device_sampling_config, sampling_params,
+            row_seeds, position_ids)                            # (B, W)
+    else:
+        # verify-row acceptance: the same greedy the eager paged step
+        # applies (sampling_ops.sample over the untruncated head output)
+        target = sampling_ops.sample(logits, None, None, None)  # (B, W)
     b, w = input_ids.shape
     idx = jnp.arange(w, dtype=jnp.int32)[None, :]
     if w > 1:
-        mismatch = ((input_ids[:, 1:] != greedy[:, :-1])
+        mismatch = ((input_ids[:, 1:] != target[:, :-1])
                     | (idx[:, 1:] >= widths[:, None])).astype(jnp.int32)
         n_acc = jnp.sum(jnp.cumsum(mismatch, axis=1) == 0, axis=1)
     else:
         n_acc = jnp.zeros((b,), jnp.int32)
     # emit-last rows: per-row in-graph sampling at the row's last real
-    # column — the identical sample_dp call of paged_forward_step, over
-    # the last-position slice of the SAME lm_head output
+    # column — the identical sample_dp (or coupled) call of
+    # paged_forward_step, over the last-position slice of the SAME
+    # lm_head output
     last = jnp.maximum(widths - 1, 0).astype(jnp.int32)
-    last_logits = jnp.take_along_axis(logits, last[:, None, None],
-                                      axis=1)[:, 0, :]
-    sampled = sampling_ops.sample_dp(
-        last_logits, tpu_cfg.on_device_sampling_config, sampling_params,
-        rng).reshape(b)
-    verify_toks = jnp.where(idx <= n_acc[:, None], greedy, 0)
+    if coupled:
+        sampled = jnp.take_along_axis(target, last[:, None],
+                                      axis=1)[:, 0]
+    else:
+        last_logits = jnp.take_along_axis(logits, last[:, None, None],
+                                          axis=1)[:, 0, :]
+        sampled = sampling_ops.sample_dp(
+            last_logits, tpu_cfg.on_device_sampling_config,
+            sampling_params, rng).reshape(b)
+    verify_toks = jnp.where(idx <= n_acc[:, None], target, 0)
     single_toks = jnp.where(idx == 0, sampled[:, None],
-                            jnp.zeros((), greedy.dtype))
+                            jnp.zeros((), target.dtype))
     tokens = jnp.where((emit_modes == 2)[:, None], verify_toks,
                        jnp.where((emit_modes == 1)[:, None], single_toks,
-                                 jnp.zeros((), greedy.dtype)))
+                                 jnp.zeros((), target.dtype)))
     n_emit = jnp.where(emit_modes == 2, n_acc + 1,
                        jnp.where(emit_modes == 1, 1, 0)).astype(jnp.int32)
     out = {"tokens": tokens, "num_emitted": n_emit, "cache": new_cache}
@@ -2327,6 +2401,7 @@ def spec_from_config(config: InferenceConfig, tp_degree: Optional[int] = None,
         decode_kernel=tcfg.attn_block_tkg_kernel_enabled,
         vocab_parallel=tcfg.vocab_parallel,
         quant=quant_spec_from_config(tcfg),
+        low_rank=low_rank_mod.low_rank_spec_from_config(tcfg),
         lora=lora_spec_from_config(tcfg),
         seq_parallel=bool(tcfg.sequence_parallel_enabled),
         cp_prefill=tcfg.cp_degree > 1,

@@ -1,0 +1,185 @@
+"""Low-rank (SVD-compressed) MLP factors — the NeuronMLP decode lever
+(arxiv 2510.25977: SVD-compressed tiled MLPs for memory-bound decode).
+
+Decode is HBM-bandwidth-bound: every step streams the full gate/up/down
+weights for one token's worth of FLOPs. Factorizing a (K, N) projection
+into rank-r factors U (K, r), V (r, N) cuts the streamed bytes AND the
+matmul FLOPs by r*(K+N)/(K*N) — at rank 1/4·min(K, N) that is roughly a
+2x reduction, measured here as a graph-report bytes/flops delta before
+any hardware does (ROADMAP item 5).
+
+Design mirrors ``modules/quantization.py``: a *pytree transform* run
+host-side before ``device_put``. A factorized weight is a dict
+leaf-group
+
+    {"lr_u": (..., K, r), "lr_v": (..., r, N)}
+
+consumed in-graph by :func:`~.quantization.qlinear` (two skinny matmuls
+through the rank-r bottleneck). Each factor may itself be a quantized
+leaf-group — low-rank composes with the blockwise int8/fp8 stack by
+factorizing FIRST (SVD needs the fp weight) and quantizing the factors:
+``sqrt(singular value)`` is split across U and V so both factors see
+balanced dynamic ranges.
+
+Sharding (``quantization.quantized_shardings``): lr_u keeps the dense
+weight's contraction-axis sharding (rank dim replicated), lr_v keeps the
+out-axis sharding (rank dim replicated) — so a column-parallel gate/up
+shards V, the row-parallel down shards U, and down's tp all-reduce lands
+on the tiny rank-r intermediate instead of the hidden dim (a ~H/r
+smaller wire; see ``model_base._row_parallel_out``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from .quantization import (BLOCKWISE, MXFP4, PER_CHANNEL, QuantSpec,
+                           dequantize, is_quantized_leaf, quantize_tensor)
+
+# projections eligible for factorization: the MLP family only — attention
+# projections are small relative to the MLP and rank-sensitive (the
+# reference NeuronMLP compresses the MLP tiles only). Plain (non-GLU)
+# stacks route fc1/fc2 through the gate_proj/down_proj slots, so the
+# same tuple covers them.
+DEFAULT_LOW_RANK_MODULES = ("gate_proj", "up_proj", "down_proj")
+
+
+@dataclass(frozen=True)
+class LowRankSpec:
+    """Static low-rank description (hashable; closed over by jit).
+
+    rank: the factor rank r; modules: weight names to factorize."""
+
+    rank: int
+    modules: Tuple[str, ...] = DEFAULT_LOW_RANK_MODULES
+
+    def converts(self, name: str) -> bool:
+        return name in self.modules
+
+
+def low_rank_spec_from_config(tpu_config) -> Optional[LowRankSpec]:
+    """Resolve a LowRankSpec from the ``TpuConfig.mlp_low_rank`` knob."""
+    rank = getattr(tpu_config, "mlp_low_rank", None)
+    if not rank:
+        return None
+    return LowRankSpec(rank=int(rank))
+
+
+def is_low_rank_leaf(w: Any) -> bool:
+    return isinstance(w, dict) and "lr_u" in w
+
+
+# ---------------------------------------------------------------------------
+# host-side factorization (numpy) — run before device_put, like
+# quantization.quantize_params
+# ---------------------------------------------------------------------------
+
+def factorize_tensor(w: np.ndarray, rank: int) -> Dict[str, np.ndarray]:
+    """SVD-factorize one weight (..., K, N) into the best (Eckart–Young)
+    rank-``rank`` pair. Leading dims (layer stack L) batch through
+    numpy's batched SVD. ``sqrt(singular value)`` lands on both factors
+    so their dynamic ranges stay balanced for factor quantization."""
+    w = np.asarray(w)
+    dt = w.dtype
+    wf = w.astype(np.float32)
+    r = min(int(rank), min(wf.shape[-2], wf.shape[-1]))
+    u, s, vh = np.linalg.svd(wf, full_matrices=False)
+    root = np.sqrt(s[..., :r])
+    lr_u = (u[..., :, :r] * root[..., None, :]).astype(dt)
+    lr_v = (root[..., :, None] * vh[..., :r, :]).astype(dt)
+    return {"lr_u": lr_u, "lr_v": lr_v}
+
+
+def _quantize_factor(factor: np.ndarray, qspec: QuantSpec) -> Any:
+    """Quantize one factor, degrading the scheme where the rank-r
+    contraction dim can't satisfy it: blockwise falls back to
+    per-channel when r doesn't divide into groups, and mxfp4 (whose
+    packing needs the group structure) leaves the factor in full
+    precision rather than mis-packing it."""
+    K = factor.shape[-2]
+    if qspec.dtype == MXFP4:
+        if K % qspec.group_size:
+            return factor
+        return quantize_tensor(factor, qspec)
+    if qspec.scheme == BLOCKWISE and K % qspec.group_size:
+        qspec = dataclasses.replace(qspec, scheme=PER_CHANNEL)
+    return quantize_tensor(factor, qspec)
+
+
+def factorize_params(params: Dict[str, Any], spec: LowRankSpec,
+                     quant: Optional[QuantSpec] = None) -> Dict[str, Any]:
+    """Transform a param tree: replace eligible MLP weights with
+    {"lr_u", "lr_v"} leaf-groups. When ``quant`` also targets the
+    weight, each factor is quantized in place — run this BEFORE
+    ``quantize_params`` (the SVD needs the fp weight; already-factorized
+    leaves carry no convertible names, so the later quantize walk leaves
+    them alone)."""
+
+    def convert(tree):
+        out = {}
+        for name, v in tree.items():
+            if isinstance(v, dict) and not is_low_rank_leaf(v) \
+                    and not is_quantized_leaf(v):
+                out[name] = convert(v)
+            elif spec.converts(name) and not isinstance(v, dict):
+                leaf = factorize_tensor(np.asarray(v), spec.rank)
+                if quant is not None and quant.converts(name):
+                    leaf = {k: _quantize_factor(f, quant)
+                            for k, f in leaf.items()}
+                out[name] = leaf
+            else:
+                out[name] = v
+        return out
+
+    return convert(params)
+
+
+# ---------------------------------------------------------------------------
+# accuracy pin + bytes/flops accounting (the observatory's pre-hardware
+# yardsticks)
+# ---------------------------------------------------------------------------
+
+def _factor_dense(factor: Any) -> np.ndarray:
+    if is_quantized_leaf(factor):
+        return np.asarray(dequantize(factor, np.float32))
+    return np.asarray(factor, dtype=np.float32)
+
+
+def reconstruction_error(w: np.ndarray, leaf: Dict[str, Any]) -> float:
+    """Relative Frobenius error ||W - U·V|| / ||W|| of one factorized
+    (possibly factor-quantized) leaf against the dense weight — the pin
+    tests hold at the chosen rank."""
+    wf = np.asarray(w, dtype=np.float32)
+    approx = _factor_dense(leaf["lr_u"]) @ _factor_dense(leaf["lr_v"])
+    denom = float(np.linalg.norm(wf))
+    return float(np.linalg.norm(wf - approx)) / max(denom, 1e-30)
+
+
+def compression_report(hidden_size: int, intermediate_size: int,
+                       num_layers: int, rank: int, glu: bool = True,
+                       bytes_per_param: float = 4.0) -> Dict[str, Any]:
+    """Analytic decode bytes/flops delta of the low-rank MLP: dense
+    gate/up/down stream L·n_proj·H·I params per token (decode reads
+    every weight once; flops = 2·params), the factor pairs stream
+    L·n_proj·r·(H+I). The ratio is the projected HBM-bandwidth win the
+    graph report carries until hardware measures it."""
+    h, i, r = hidden_size, intermediate_size, int(rank)
+    n_proj = 3 if glu else 2
+    dense_params = num_layers * n_proj * h * i
+    lr_params = num_layers * n_proj * r * (h + i)
+    ratio = lr_params / dense_params
+    return {
+        "rank": r,
+        "mlp_projections": num_layers * n_proj,
+        "dense_mlp_bytes": int(dense_params * bytes_per_param),
+        "low_rank_mlp_bytes": int(lr_params * bytes_per_param),
+        "dense_mlp_flops_per_token": 2 * dense_params,
+        "low_rank_mlp_flops_per_token": 2 * lr_params,
+        "bytes_ratio": round(ratio, 4),
+        "flops_ratio": round(ratio, 4),
+        "projected_decode_mlp_speedup": round(1.0 / max(ratio, 1e-9), 2),
+    }

@@ -256,12 +256,18 @@ def dequantize(leaf: Dict[str, Any], dtype=jnp.bfloat16) -> jnp.ndarray:
 
 
 def qlinear(x: jnp.ndarray, w: Any) -> jnp.ndarray:
-    """Linear that accepts fp arrays OR quantized leaf-groups.
+    """Linear that accepts fp arrays, quantized leaf-groups, OR low-rank
+    factor pairs.
 
     int8/fp8 per-channel/per-tensor: scale commutes out of the contraction —
     (x @ q) * scale_row keeps the weight stream int8 in HBM (the whole point:
     decode is HBM-bandwidth-bound, int8 halves the weight bytes).
     """
+    if isinstance(w, dict) and "lr_u" in w:
+        # low-rank (SVD) factor pair (modules/low_rank.py): two skinny
+        # matmuls through the rank-r bottleneck; each factor may itself
+        # be a quantized leaf-group — the recursion composes both wins
+        return qlinear(qlinear(x, w["lr_u"]), w["lr_v"])
     if not is_quantized_leaf(w):
         return x @ w
     scheme = _leaf_scheme(w)
@@ -303,12 +309,51 @@ def qeinsum(pattern: str, x: jnp.ndarray, w: Any) -> jnp.ndarray:
 # sharding of quantized trees
 # ---------------------------------------------------------------------------
 
+def _qleaf_shardings(entries: List[Any], v: Dict[str, Any], mesh):
+    """Shardings for one quantized leaf-group, given the fp weight's
+    PartitionSpec entries: qweight inherits the weight's sharding; scale
+    inherits it with the contraction axis unsharded (its extent is 1 or
+    K/group); size-1 dims (per-tensor) can't carry a mesh axis."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    q_ndim = v["qweight"].ndim
+    entries = list(entries) + [None] * (q_ndim - len(entries))
+    s_shape = v["scale"].shape
+    s_entries = entries[:q_ndim - 2] + [None, entries[q_ndim - 1]]
+    s_entries = [e if d > 1 else None
+                 for e, d in zip(s_entries, s_shape)]
+    return {"qweight": NamedSharding(mesh, P(*entries[:q_ndim])),
+            "scale": NamedSharding(mesh, P(*s_entries))}
+
+
+def _low_rank_leaf_shardings(sh, v: Dict[str, Any], mesh):
+    """Shardings for a low-rank factor pair (modules/low_rank.py): lr_u
+    (..., K, r) keeps the fp weight's contraction-axis sharding with the
+    rank dim replicated; lr_v (..., r, N) keeps the out-axis sharding
+    with the rank dim replicated — so a column-parallel weight shards V,
+    a row-parallel weight shards U, and the reduction lands on the tiny
+    rank-r intermediate. Factors that are themselves quantized
+    leaf-groups recurse through the qweight/scale rule."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    u = v["lr_u"]
+    nd = u["qweight"].ndim if is_quantized_leaf(u) else u.ndim
+    entries = list(sh.spec) + [None] * (nd - len(sh.spec))
+    lead = entries[:nd - 2]
+    u_entries = lead + [entries[nd - 2], None]
+    v_entries = lead + [None, entries[nd - 1]]
+
+    def one(factor, ent):
+        if is_quantized_leaf(factor):
+            return _qleaf_shardings(ent, factor, mesh)
+        return NamedSharding(mesh, P(*ent))
+
+    return {"lr_u": one(u, u_entries), "lr_v": one(v["lr_v"], v_entries)}
+
+
 def quantized_shardings(fp_shardings: Dict[str, Any], params: Dict[str, Any],
                         mesh) -> Dict[str, Any]:
-    """Derive shardings for a quantized param tree from the fp ParamSpec
-    shardings: qweight inherits the weight's sharding; scale inherits it with
-    the contraction axis unsharded (its extent is 1 or K/group)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    """Derive shardings for a quantized and/or low-rank-factorized param
+    tree from the fp ParamSpec shardings (see :func:`_qleaf_shardings` /
+    :func:`_low_rank_leaf_shardings` for the per-leaf rules)."""
 
     def walk(sh_tree, p_tree):
         out = {}
@@ -318,16 +363,9 @@ def quantized_shardings(fp_shardings: Dict[str, Any], params: Dict[str, Any],
                 wspec = sh.spec
                 q_ndim = v["qweight"].ndim
                 entries = list(wspec) + [None] * (q_ndim - len(wspec))
-                # scale layout mirrors the weight with the contraction axis
-                # reduced; size-1 dims (per-tensor) can't carry a mesh axis
-                s_shape = v["scale"].shape
-                s_entries = entries[:q_ndim - 2] + [None, entries[q_ndim - 1]]
-                s_entries = [e if d > 1 else None
-                             for e, d in zip(s_entries, s_shape)]
-                out[name] = {
-                    "qweight": NamedSharding(mesh, P(*entries[:q_ndim])),
-                    "scale": NamedSharding(mesh, P(*s_entries)),
-                }
+                out[name] = _qleaf_shardings(entries, v, mesh)
+            elif isinstance(v, dict) and "lr_u" in v:
+                out[name] = _low_rank_leaf_shardings(sh, v, mesh)
             elif isinstance(v, dict):
                 out[name] = walk(sh, v)
             else:

@@ -52,14 +52,13 @@ def greedy_sample(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def topk_topp_sample(logits: jnp.ndarray, sampling_params: jnp.ndarray,
-                     key: jax.Array, global_topk: int = 256,
-                     deterministic: bool = False) -> jnp.ndarray:
-    """Per-request top-k/top-p/temperature sampling.
-
-    logits (B, V); sampling_params (B, 3) = [top_k, top_p, temperature].
-    top_k <= 0 or >= global_topk means "no k truncation beyond global_topk".
-    """
+def truncated_probs(logits: jnp.ndarray, sampling_params: jnp.ndarray,
+                    global_topk: int = 256):
+    """The shared top-k/top-p/temperature truncation: logits (B, V) +
+    sampling_params (B, 3) -> (probs, top_idx), both (B, k) with
+    k = min(global_topk, V), probs renormalized over the kept prefix.
+    top_k <= 0 or >= global_topk means "no k truncation beyond
+    global_topk"."""
     b, v = logits.shape
     k = min(global_topk, v)
     lf = logits.astype(jnp.float32)
@@ -80,7 +79,17 @@ def topk_topp_sample(logits: jnp.ndarray, sampling_params: jnp.ndarray,
     pmask = (cum - probs) < req_p[:, None]
     probs = jnp.where(pmask & kmask, probs, 0.0)
     probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return probs, top_idx
 
+
+def topk_topp_sample(logits: jnp.ndarray, sampling_params: jnp.ndarray,
+                     key: jax.Array, global_topk: int = 256,
+                     deterministic: bool = False) -> jnp.ndarray:
+    """Per-request top-k/top-p/temperature sampling.
+
+    logits (B, V); sampling_params (B, 3) = [top_k, top_p, temperature].
+    """
+    probs, top_idx = truncated_probs(logits, sampling_params, global_topk)
     if deterministic:
         choice = jnp.argmax(probs, axis=-1)
     else:
@@ -88,6 +97,73 @@ def topk_topp_sample(logits: jnp.ndarray, sampling_params: jnp.ndarray,
         g = jax.random.gumbel(key, probs.shape, dtype=jnp.float32)
         choice = jnp.argmax(jnp.where(probs > 0, jnp.log(probs) + g, -jnp.inf), axis=-1)
     return jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+def stream_keys(stream_seed: int, seeds: jnp.ndarray,
+                positions: jnp.ndarray) -> jax.Array:
+    """Per-draw PRNG keys for the positionally coupled stream: row i's
+    key is ``fold_in(fold_in(PRNGKey(stream_seed), seeds[i]),
+    positions[i])`` — a pure function of (engine stream seed, request
+    seed, absolute position of the token whose logits are sampled), so
+    the same draw falls out of ANY graph that samples that position:
+    eager decode, the fused decode loop, the prefill tail, a draft-loop
+    step, a verify column, or a ragged verify row."""
+    base = jax.random.PRNGKey(stream_seed)
+    return jax.vmap(lambda s, p: jax.random.fold_in(
+        jax.random.fold_in(base, s), p))(
+        seeds.astype(jnp.int32), positions.astype(jnp.int32))
+
+
+def coupled_sample(logits: jnp.ndarray,
+                   config: OnDeviceSamplingConfig,
+                   sampling_params: Optional[jnp.ndarray],
+                   seeds: jnp.ndarray,
+                   positions: jnp.ndarray) -> jnp.ndarray:
+    """Positionally coupled top-k/top-p/temperature sampling.
+
+    Unlike :func:`sample` (one gumbel block per dispatch, so streams
+    depend on scheduling), every draw here is keyed by
+    :func:`stream_keys` and the per-row gumbel noise has a fixed shape
+    (k,), making the sampled token a pure function of (stream_seed,
+    request seed, position, logits). That invariance is what makes
+    gumbel-coupled rejection sampling exact: the verify graph's coupled
+    draw at position p IS the token eager decode would have sampled at
+    p, so accept-by-exact-match preserves both the output distribution
+    and the stream (see README "Sampled speculation & compressed
+    decode").
+
+    logits (B, V) with seeds (B,) / positions (B,), or (B, T, V) with
+    positions (B, T); sampling_params (B, 3) or None (config-static).
+    """
+    squeeze = False
+    if logits.ndim == 3:
+        b, t, v = logits.shape
+        logits = logits.reshape(b * t, v)
+        seeds = jnp.broadcast_to(seeds[:, None], (b, t)).reshape(-1)
+        positions = positions.reshape(-1)
+        if sampling_params is not None and sampling_params.shape[0] == b:
+            sampling_params = jnp.repeat(sampling_params, t, axis=0)
+        squeeze = (b, t)
+    if sampling_params is None:
+        sampling_params = jnp.broadcast_to(
+            jnp.array([[config.top_k, config.top_p, config.temperature]],
+                      jnp.float32), (logits.shape[0], 3))
+    probs, top_idx = truncated_probs(logits, sampling_params,
+                                     config.global_topk)
+    if config.deterministic:
+        choice = jnp.argmax(probs, axis=-1)
+    else:
+        keys = stream_keys(config.stream_seed or 0, seeds, positions)
+        kwidth = probs.shape[-1]
+        g = jax.vmap(lambda k: jax.random.gumbel(k, (kwidth,),
+                                                 jnp.float32))(keys)
+        choice = jnp.argmax(jnp.where(probs > 0, jnp.log(probs) + g,
+                                      -jnp.inf), axis=-1)
+    toks = jnp.take_along_axis(top_idx, choice[:, None],
+                               axis=-1)[:, 0].astype(jnp.int32)
+    if squeeze:
+        toks = toks.reshape(squeeze)
+    return toks
 
 
 def sample(logits: jnp.ndarray, config: Optional[OnDeviceSamplingConfig],

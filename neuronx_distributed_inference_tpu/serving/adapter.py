@@ -189,6 +189,18 @@ def _meta_tenant(meta: Any) -> str:
         return ""
 
 
+def _meta_seed(meta: Any) -> int:
+    """Per-request sampling seed from an opaque ``meta`` payload: the
+    serving engine passes mappings with a "sampling_seed" key; everything
+    else (including the non-engine default None) seeds as 0. Feeds the
+    positionally coupled sampling stream (ops/sampling.stream_keys) —
+    two requests with the same seed and prompt sample the same tokens."""
+    try:
+        return int(meta.get("sampling_seed", 0))
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
 def _common_tenant(tenants) -> str:
     """The single tenant shared by every affected row, or "" when the set
     is empty or mixed — per-call failure counters label with ONE tenant,
@@ -293,10 +305,13 @@ class _AdapterTelemetry:
         self._rows(reg, "decode", n, padded, steps=steps)
 
     def on_spec_step(self, rows: Sequence[Tuple[int, int]], t0: float,
-                     padded: int, width: int, drafted: int, accepted: int):
+                     padded: int, width: int, drafted: int, accepted: int,
+                     mode: str = "greedy"):
         """One speculative engine step: ``rows`` is (seq_id, tokens
         delivered) per live row — per-request TPOT counts every delivered
-        token, and the spec counters pin the drafted/accepted split."""
+        token, and the spec counters pin the drafted/accepted split.
+        ``mode`` labels the verify discipline (greedy | sampled) so the
+        two acceptance regimes never alias in one series."""
         reg = self.registry
         now = time.perf_counter()
         delivered = 0
@@ -312,12 +327,15 @@ class _AdapterTelemetry:
                                                     engine=self.engine)
         tmetrics.generated_tokens_counter(reg).inc(delivered,
                                                    engine=self.engine)
-        tmetrics.spec_drafted_counter(reg).inc(drafted, engine=self.engine)
+        tmetrics.spec_drafted_counter(reg).inc(drafted, engine=self.engine,
+                                               mode=mode)
         tmetrics.spec_accepted_counter(reg).inc(accepted,
-                                                engine=self.engine)
+                                                engine=self.engine,
+                                                mode=mode)
         if drafted:
             tmetrics.spec_accept_rate_gauge(reg).set(accepted / drafted,
-                                                     engine=self.engine)
+                                                     engine=self.engine,
+                                                     mode=mode)
         tmetrics.spec_verify_width_histogram(reg).observe(
             width, engine=self.engine)
         self._rows(reg, "decode", len(rows), padded)
@@ -583,13 +601,21 @@ class _PagedScratch:
     the buffers a still-in-flight dispatch aliases are never rewritten."""
 
     def __init__(self, live: Sequence[int], pad_to: int, width: int,
-                 block_size: int):
+                 block_size: int, seeds: Optional[Sequence[int]] = None):
         b = len(live)
         self.live = tuple(live)
         self.b = b
         self.pad_to = pad_to
         self.width = width
         self.last = np.zeros((pad_to,), np.int32)    # immutable after init
+        # per-sequence sampling-stream seeds are constants of the live
+        # composition (request meta never changes mid-flight), so the
+        # buffer is immutable after init like ``last`` — no ping-pong
+        self.seeds = np.zeros((pad_to,), np.int32)   # immutable after init
+        if seeds is not None:
+            self.seeds[:b] = np.asarray(seeds, np.int32)
+            if pad_to > b:
+                self.seeds[b:] = self.seeds[0]
         self._bufs = [(np.empty((pad_to, 1), np.int32),
                        np.empty((pad_to, 1), np.int32),
                        np.empty((pad_to, 1), np.int32),
@@ -1613,7 +1639,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
         if (scr is None or scr.live != tuple(live) or scr.pad_to != pad_to
                 or scr.width != width):
             scr = self._scratch = _PagedScratch(
-                live, pad_to, width, app.kv_mgr.spec.block_size)
+                live, pad_to, width, app.kv_mgr.spec.block_size,
+                seeds=[_meta_seed(self.seqs[s].meta) for s in live])
         return scr
 
     def _dispatch_decode(self, scr: _PagedScratch, toks_dev=None):
@@ -1627,10 +1654,10 @@ class PagedEngineAdapter(_EngineAdapterBase):
             # trace lanes (serving/warmup.py steady-state discipline)
             with self.app.request_context(self._traces_of(scr.live)):
                 out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
-                                          scr.last)
+                                          scr.last, row_seeds=scr.seeds)
         else:
             out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
-                                      scr.last)
+                                      scr.last, row_seeds=scr.seeds)
         _async_fetch(out["tokens"])
         self.host_stats["dispatches"] += 1
         self.host_stats["device_steps"] += 1
@@ -1653,23 +1680,28 @@ class PagedEngineAdapter(_EngineAdapterBase):
         bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
         first = np.empty((b,), np.int32)
         pos = np.empty((b,), np.int32)
+        seeds = np.empty((b,), np.int32)
         for i, s in enumerate(live):
             st = self.seqs[s]
             first[i] = st.last_token
             pos[i] = st.position
+            seeds[i] = _meta_seed(st.meta)
         if pad_to > b:
             first = _repeat_row0(first, pad_to)
             pos = _repeat_row0(pos, pad_to)
             bt = _repeat_row0(bt, pad_to)
+            seeds = _repeat_row0(seeds, pad_to)
         cache_before = app.cache
         try:
             if _FAULTS.active:
                 _FAULTS.fire("decode_step")
             if app._steady_state:
                 with app.request_context(self._traces_of(live)):
-                    out = app._run_paged_loop(first, pos, bt, num_steps)
+                    out = app._run_paged_loop(first, pos, bt, num_steps,
+                                              row_seeds=seeds)
             else:
-                out = app._run_paged_loop(first, pos, bt, num_steps)
+                out = app._run_paged_loop(first, pos, bt, num_steps,
+                                          row_seeds=seeds)
             self.host_stats["dispatches"] += 1
             self.host_stats["device_steps"] += num_steps
             rec = _get_recorder()
@@ -2154,9 +2186,14 @@ class PagedEngineAdapter(_EngineAdapterBase):
             if fin:
                 last[i] = n - 1
         slots = slots_from_table(bt, slot_pos, app.kv_mgr.spec.block_size)
+        seeds = np.asarray([_meta_seed(self._chunks[s].meta) for s in sids],
+                           np.int32)
         pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
                                                  kind="batch")
-        return _pad_paged_rows(pad_to, ids_w, pos_w, slots, bt, last)
+        if pad_to > b:
+            seeds = _repeat_row0(seeds, pad_to)
+        return _pad_paged_rows(pad_to, ids_w, pos_w, slots, bt, last) \
+            + (seeds,)
 
     def _dispatch_prefill_chunk(self, packed, fetch: bool = True):
         """Issue ONE packed prefill-chunk dispatch without materializing
@@ -2164,8 +2201,9 @@ class PagedEngineAdapter(_EngineAdapterBase):
         chunk token fetch happens in the caller, one async hop behind.
         ``fetch=False`` (intermediate-only dispatch) skips even the async
         device-to-host copy: those samples are never read."""
-        ids_p, pos_p, slots_p, bt_p, last_p = packed
-        out = self.app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p)
+        ids_p, pos_p, slots_p, bt_p, last_p, seeds_p = packed
+        out = self.app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p,
+                                  row_seeds=seeds_p)
         if fetch:
             _async_fetch(out["tokens"])
         self.host_stats["prefill_dispatches"] += 1

@@ -16,8 +16,10 @@
      never round-trip through the host);
   4. THE ragged dispatch (``model_base.paged_ragged_step``): in-graph
      per-row sampling for decode rows and final prefill chunks, in-graph
-     greedy exact-match acceptance for verify windows, nothing emitted
-     for intermediate chunks and pad rows;
+     acceptance for verify windows — greedy exact-match, or gumbel-
+     coupled rejection sampling under seeded sampled decode (README
+     "Sampled speculation & compressed decode") — nothing emitted for
+     intermediate chunks and pad rows;
   5. the ONE blocking fetch of the step, then host bookkeeping: chunk
      cursors advance (final chunks graduate to running rows),
      ``_unwritten`` blocks covered by the now-materialized write chain
@@ -52,8 +54,9 @@ from ...resilience.faults import FAULTS as _FAULTS
 from ...telemetry.request_trace import trace_of as _trace_of
 from ...telemetry.trace import get_recorder as _get_recorder
 from ..adapter import (_async_fetch, _common_tenant, _live_rows,
-                       _meta_tenant, _pre_step_checks, _repeat_row0,
-                       _trace_error)
+                       _meta_seed, _meta_tenant, _pre_step_checks,
+                       _repeat_row0, _trace_error)
+from ..speculation.verifier import validate_spec_sampling
 from .planner import (KIND_DECODE, KIND_PREFILL, KIND_VERIFY,
                       RaggedBatchPlanner, RaggedPlan)
 
@@ -73,11 +76,8 @@ class RaggedDispatchPath:
             raise ConfigurationError(
                 "the ragged unified dispatch over rolling-window caches "
                 "is not supported (row offsets need absolute positions)")
-        if cfg.on_device_sampling_config is not None:
-            raise ConfigurationError(
-                "ragged unified dispatch is greedy-only for now: drop "
-                "on_device_sampling_config (the rejection-sampling hook "
-                "is documented in README \"Speculative serving\")")
+        self.mode = validate_spec_sampling(cfg.on_device_sampling_config,
+                                           where="ragged unified dispatch")
         self.adapter = adapter
         self.planner = RaggedBatchPlanner(adapter)
         # ONE warm-shape ladder for every row kind (decode / verify /
@@ -136,7 +136,9 @@ class RaggedDispatchPath:
                              horizon=1)
         t0 = time.perf_counter()
         # degradation shed: verify windows clamp to width 1 (decode-kind
-        # rows, no draft dispatch) — greedy tokens unchanged
+        # rows, no draft dispatch) — tokens unchanged in both modes
+        # (greedy argmax trivially; coupled sampling because the
+        # position-keyed draws are path-invariant)
         max_width = 1 if ad._spec_shed else self.max_width
         plan = self.planner.plan(live, seq_ids, token_room, max_width)
         if plan.live_ids:
@@ -282,10 +284,14 @@ class RaggedDispatchPath:
                 emit[i] = (_EMIT_VERIFY if r.kind == KIND_VERIFY
                            else _EMIT_LAST)
         slots = slots_from_table(bt, slot_pos, bs)
+        seeds = np.asarray(
+            [_meta_seed(ad.seqs[r.seq_id].meta if r.seq_id in ad.seqs
+                        else chunks[r.seq_id].meta) for r in rows],
+            np.int32)
         if pad_to > b:
-            ids, pos, slots, bt, wid, emit = (
+            ids, pos, slots, bt, wid, emit, seeds = (
                 _repeat_row0(x, pad_to)
-                for x in (ids, pos, slots, bt, wid, emit))
+                for x in (ids, pos, slots, bt, wid, emit, seeds))
         ids_dev = jnp.asarray(ids)
         if drafts is not None and spec_W > 1:
             # merge the device-resident drafts into the packed input —
@@ -322,7 +328,7 @@ class RaggedDispatchPath:
             if _FAULTS.active:
                 _FAULTS.fire("ragged_step")
             out = self._dispatch_ragged(ids_dev, pos, slots, bt, wid,
-                                        emit, rows)
+                                        emit, seeds, rows)
             toks, n_emit = self._fetch_ragged(out, b)
         except ServingError as e:
             self._rollback_plan(plan)
@@ -420,7 +426,7 @@ class RaggedDispatchPath:
             stats["spec_accepted_tokens"] += accepted
             ad.telemetry.on_spec_step(spec_rows, t0, padded=pad_to,
                                       width=spec_W, drafted=drafted,
-                                      accepted=accepted)
+                                      accepted=accepted, mode=self.mode)
         elif spec_rows:
             ad.telemetry.on_step([s for s, _ in spec_rows], t0,
                                  padded=pad_to)
@@ -450,7 +456,8 @@ class RaggedDispatchPath:
         return res
 
     # -- dispatch region (nxdi_lint host-sync pass) ------------------------
-    def _dispatch_ragged(self, ids_dev, pos, slots, bt, wid, emit, rows):
+    def _dispatch_ragged(self, ids_dev, pos, slots, bt, wid, emit, seeds,
+                         rows):
         """Issue THE unified dispatch (one per engine step) without
         materializing any output; the async copies are started so the
         fetch one call later is cheap."""
@@ -463,10 +470,12 @@ class RaggedDispatchPath:
                     self._row_trace(r.seq_id) for r in rows):
                 out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid,
                                          emit,
-                                         want_hidden=self.wants_hidden)
+                                         want_hidden=self.wants_hidden,
+                                         row_seeds=seeds)
         else:
             out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid, emit,
-                                     want_hidden=self.wants_hidden)
+                                     want_hidden=self.wants_hidden,
+                                     row_seeds=seeds)
         _async_fetch(out["tokens"])
         _async_fetch(out["num_emitted"])
         ad.host_stats["dispatches"] += 1

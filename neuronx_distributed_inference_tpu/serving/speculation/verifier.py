@@ -12,9 +12,11 @@ decode on a :class:`~..adapter.PagedEngineAdapter`:
   3. the proposer's draft pass (device-resident tokens — drafts never
      round-trip through the host, in eager AND pipelined modes);
   4. ONE batched k+1-token verify dispatch over the existing
-     block-table/slot-mapping graph with in-graph greedy acceptance
-     (``model_base.paged_spec_verify``), columns past a row's width at
-     slot -1 (dropped writes);
+     block-table/slot-mapping graph with in-graph acceptance
+     (``model_base.paged_spec_verify``) — greedy exact-match, or
+     gumbel-coupled rejection sampling when the adapter runs seeded
+     sampled decode (README "Sampled speculation & compressed decode") —
+     columns past a row's width at slot -1 (dropped writes);
   5. host accept bookkeeping: per-sequence accept cursors advance
      ``_SeqState.position``/``tokens`` by ``num_emitted``, KV shrinks to
      the accepted prefix (``BlockKVCacheManager.shrink``), and the step
@@ -47,13 +49,35 @@ from ...resilience.errors import (CapacityError, ConfigurationError,
                                   ServingError, StepFailure)
 from ...resilience.faults import FAULTS as _FAULTS
 from ...telemetry.trace import get_recorder as _get_recorder
-from ..adapter import (_async_fetch, _live_rows, _pre_step_checks,
-                       _repeat_row0, _trace_error)
+from ..adapter import (_async_fetch, _live_rows, _meta_seed,
+                       _pre_step_checks, _repeat_row0, _trace_error)
 from .proposer import DraftProposer
 
-__all__ = ["SpeculativeDecodePath"]
+__all__ = ["SpeculativeDecodePath", "validate_spec_sampling"]
 
 logger = logging.getLogger("nxdi_tpu")
+
+
+def validate_spec_sampling(sampling_config, where: str) -> str:
+    """Resolve a speculative path's verify mode from the adapter's
+    on-device sampling config: ``"greedy"`` (no config, or
+    ``do_sample=False``) or ``"sampled"`` (seeded coupled sampling —
+    ``do_sample=True`` with ``stream_seed`` set). UNSEEDED sampling is
+    the one still-refused configuration: without a stream seed every
+    dispatch draws fresh noise, so verify could never reproduce the
+    target draw a draft must match and the emitted stream would depend
+    on batch composition."""
+    if sampling_config is None or not sampling_config.do_sample:
+        return "greedy"
+    if sampling_config.stream_seed is None:
+        raise ConfigurationError(
+            f"{where} supports sampled speculation only for SEEDED "
+            "streams: set on_device_sampling_config.stream_seed (coupled "
+            "rejection sampling replays the per-position gumbel draw the "
+            "draft must match). Supported: greedy (do_sample=False, no "
+            "seed needed) and seeded sampling; unseeded do_sample is "
+            "not.")
+    return "sampled"
 
 
 @dataclass
@@ -71,6 +95,7 @@ class _SpecContext:
     positions: np.ndarray          # (Bp,) their positions
     widths: np.ndarray             # (Bp,) per-row candidate widths
     block_table: np.ndarray        # (Bp, table-width bucket)
+    seeds: np.ndarray = None       # (Bp,) per-row sampling stream seeds
     cand: Any = field(default=None)  # (Bp, W) device candidates
 
 
@@ -87,11 +112,8 @@ class SpeculativeDecodePath:
             raise ConfigurationError(
                 "speculative decode over rolling-window caches is not "
                 "supported (the accept window needs absolute positions)")
-        if cfg.on_device_sampling_config is not None:
-            raise ConfigurationError(
-                "speculative serving is greedy-only for now: drop "
-                "on_device_sampling_config (the rejection-sampling hook "
-                "is documented in README \"Speculative serving\")")
+        self.mode = validate_spec_sampling(cfg.on_device_sampling_config,
+                                           where="speculative serving")
         self.adapter = adapter
         self.proposer = proposer
         self.max_width = proposer.max_drafts + 1
@@ -138,7 +160,9 @@ class SpeculativeDecodePath:
         limit = ad._pos_limit
         # degradation shed: every window clamps to width 1 — the step
         # degenerates to the eager-equivalent verify (no draft dispatch,
-        # same greedy tokens); see PagedEngineAdapter.set_speculation_shed
+        # same tokens in both modes: greedy argmax trivially, coupled
+        # sampling because the position-keyed draws are path-invariant);
+        # see PagedEngineAdapter.set_speculation_shed
         max_w = 1 if ad._spec_shed else self.max_width
         widths = {}
         for s in live:
@@ -215,14 +239,17 @@ class SpeculativeDecodePath:
         first = np.asarray([ad.seqs[s].last_token for s in live], np.int32)
         pos = np.asarray([ad.seqs[s].position for s in live], np.int32)
         wid = np.asarray([widths[s] for s in live], np.int32)
+        seeds = np.asarray([_meta_seed(ad.seqs[s].meta) for s in live],
+                           np.int32)
         bt = app.kv_mgr.block_table_array(live, app._bt_width_for(live))
         if pad_to > b:
-            first, pos, wid, bt = (_repeat_row0(x, pad_to)
-                                   for x in (first, pos, wid, bt))
+            first, pos, wid, seeds, bt = (_repeat_row0(x, pad_to)
+                                          for x in (first, pos, wid,
+                                                    seeds, bt))
         ctx = _SpecContext(path=self, live=tuple(live), b=b,
                            padded_batch=pad_to, num_drafts=W - 1,
                            first=first, positions=pos, widths=wid,
-                           block_table=bt)
+                           block_table=bt, seeds=seeds)
         cache_before = app.cache
         try:
             if _FAULTS.active:
@@ -323,7 +350,8 @@ class SpeculativeDecodePath:
         stats["spec_drafted_tokens"] += drafted
         stats["spec_accepted_tokens"] += accepted
         ad.telemetry.on_spec_step(rows, t0, padded=pad_to, width=W,
-                                  drafted=drafted, accepted=accepted)
+                                  drafted=drafted, accepted=accepted,
+                                  mode=self.mode)
         try:
             self.proposer.on_verify(ctx, toks, n_emit,
                                     out.get("hidden")
@@ -347,7 +375,7 @@ class SpeculativeDecodePath:
         ad = self.adapter
         out = ad.app._run_spec_draft(ctx.first, ctx.positions,
                                      ctx.block_table, ctx.widths,
-                                     ctx.num_drafts)
+                                     ctx.num_drafts, row_seeds=ctx.seeds)
         ad.host_stats["dispatches"] += 1
         ad.host_stats["spec_draft_dispatches"] += 1
         ad.host_stats["device_steps"] += ctx.num_drafts
@@ -388,7 +416,7 @@ class SpeculativeDecodePath:
         ad = self.adapter
         out = ad.app._run_spec_verify(
             cand, pos_w, slots, ctx.block_table, ctx.widths,
-            want_hidden=self.proposer.wants_hidden)
+            want_hidden=self.proposer.wants_hidden, row_seeds=ctx.seeds)
         _async_fetch(out["tokens"])
         _async_fetch(out["num_emitted"])
         ad.host_stats["dispatches"] += 1

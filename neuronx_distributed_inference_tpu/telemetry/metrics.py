@@ -430,24 +430,27 @@ def spec_drafted_counter(reg):
     return reg.counter(
         SPEC_DRAFTED_TOKENS_TOTAL,
         "Draft tokens proposed per speculative verify dispatch "
-        "(accepted + rejected; excludes the always-emitted bonus token)",
-        labels=("engine",))
+        "(accepted + rejected; excludes the always-emitted bonus token), "
+        "split by verify mode (greedy | sampled)",
+        labels=("engine", "mode"))
 
 
 def spec_accepted_counter(reg):
     return reg.counter(
         SPEC_ACCEPTED_TOKENS_TOTAL,
         "Draft tokens the verify dispatch accepted (the gap to "
-        "nxdi_spec_drafted_tokens_total is wasted draft work)",
-        labels=("engine",))
+        "nxdi_spec_drafted_tokens_total is wasted draft work), split by "
+        "verify mode (greedy | sampled)",
+        labels=("engine", "mode"))
 
 
 def spec_accept_rate_gauge(reg):
     return reg.gauge(
         SPEC_ACCEPT_RATE,
         "Per-step draft acceptance rate (accepted/drafted of the last "
-        "speculative engine step; 1.0 under greedy self-drafting)",
-        labels=("engine",))
+        "speculative engine step; 1.0 under greedy or coupled-sampled "
+        "self-drafting), split by verify mode (greedy | sampled)",
+        labels=("engine", "mode"))
 
 
 def spec_verify_width_histogram(reg):

@@ -1,0 +1,142 @@
+"""Low-rank (SVD) decode MLP pins (ISSUE 19).
+
+Host-side pins on ``modules/low_rank.py`` (factorization exactness,
+monotone truncation error, quant-compose degradation, the analytic
+bytes/flops report) plus the app-level acceptance pins: a FULL-rank
+factorized app emits the same greedy tokens as the dense app on the tiny
+model (SVD at rank min(K, N) is exact up to fp32 roundoff), a truncated
+app decodes end to end, and quantization composes on top of the
+factors. Random tiny-model weights have flat singular spectra, so the
+truncated-rank pins are full-rank exactness + monotonicity — not tight
+error thresholds.
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.models.application import \
+    PagedCausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.modules import low_rank as lr
+from neuronx_distributed_inference_tpu.modules.quantization import (
+    BLOCKWISE, QuantSpec, is_quantized_leaf)
+from neuronx_distributed_inference_tpu.resilience import ConfigurationError
+from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5, rope_theta=10000.0,
+          hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+PROMPT = np.random.default_rng(23).integers(1, 500, size=9).tolist()
+
+
+def _build(mlp_low_rank=None, **extra):
+    tcfg = TpuConfig(batch_size=1, seq_len=64, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_block_kv_layout=True, pa_block_size=8,
+                     pa_num_blocks=16, mlp_low_rank=mlp_low_rank, **extra)
+    a = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
+                                 LlamaFamily)
+    a.init_random_weights(7).init_cache()
+    return a
+
+
+def _greedy(app, n_decode=8):
+    eng = PagedEngineAdapter(app)
+    out = [eng.add_requests([0], [PROMPT])[0]]
+    for _ in range(n_decode):
+        out.append(eng.step()[0])
+    eng.release([0])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host-side factorization pins
+# ---------------------------------------------------------------------------
+
+def test_factorize_full_rank_exact_and_monotone():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((2, 48, 96)).astype(np.float32)  # (L, K, N)
+    exact = lr.factorize_tensor(w, 48)           # rank = min(K, N)
+    assert exact["lr_u"].shape == (2, 48, 48)
+    assert exact["lr_v"].shape == (2, 48, 96)
+    assert lr.reconstruction_error(w, exact) < 1e-5
+    e8 = lr.reconstruction_error(w, lr.factorize_tensor(w, 8))
+    e16 = lr.reconstruction_error(w, lr.factorize_tensor(w, 16))
+    assert e8 > e16 > 0.0                        # monotone in rank
+    # rank clamps to min(K, N) rather than over-allocating
+    assert lr.factorize_tensor(w, 999)["lr_u"].shape[-1] == 48
+
+
+def test_factorize_params_targets_mlp_only_and_quantizes_factors():
+    rng = np.random.default_rng(1)
+    params = {"layers": {
+        "gate_proj": rng.standard_normal((64, 128)).astype(np.float32),
+        "down_proj": rng.standard_normal((128, 64)).astype(np.float32),
+        "q_proj": rng.standard_normal((64, 64)).astype(np.float32),
+    }}
+    spec = lr.LowRankSpec(rank=16)
+    out = lr.factorize_params(params, spec)
+    assert lr.is_low_rank_leaf(out["layers"]["gate_proj"])
+    assert lr.is_low_rank_leaf(out["layers"]["down_proj"])
+    # attention projections stay dense (NeuronMLP compresses the MLP only)
+    assert not isinstance(out["layers"]["q_proj"], dict)
+    # factor-quantized compose: each factor becomes a quantized leaf, and
+    # blockwise degrades to per-channel when r doesn't divide the groups
+    q = QuantSpec(dtype="int8", scheme=BLOCKWISE, group_size=32)
+    outq = lr.factorize_params(params, spec, quant=q)
+    leaf = outq["layers"]["gate_proj"]
+    assert lr.is_low_rank_leaf(leaf)
+    assert is_quantized_leaf(leaf["lr_u"])       # contraction dim 64: ok
+    assert is_quantized_leaf(leaf["lr_v"])       # contraction dim 16 < 32
+    err = lr.reconstruction_error(params["layers"]["gate_proj"], leaf)
+    ref = lr.reconstruction_error(params["layers"]["gate_proj"],
+                                  out["layers"]["gate_proj"])
+    assert ref < err < 1.0                       # quant adds bounded noise
+
+
+def test_compression_report_math():
+    rep = lr.compression_report(64, 128, 2, rank=16, bytes_per_param=4.0)
+    # dense: 2 layers * 3 proj * 64*128; low-rank: 2*3 * 16*(64+128)
+    assert rep["dense_mlp_bytes"] == 2 * 3 * 64 * 128 * 4
+    assert rep["low_rank_mlp_bytes"] == 2 * 3 * 16 * (64 + 128) * 4
+    assert rep["bytes_ratio"] == pytest.approx(0.375)
+    assert rep["flops_ratio"] == rep["bytes_ratio"]
+    assert rep["projected_decode_mlp_speedup"] == pytest.approx(2.67)
+    assert rep["dense_mlp_flops_per_token"] == 2 * 2 * 3 * 64 * 128
+
+
+def test_low_rank_spec_from_config_knob():
+    assert lr.low_rank_spec_from_config(
+        TpuConfig(batch_size=1, seq_len=64)) is None
+    spec = lr.low_rank_spec_from_config(
+        TpuConfig(batch_size=1, seq_len=64, mlp_low_rank=16))
+    assert spec == lr.LowRankSpec(rank=16)
+    with pytest.raises(ConfigurationError, match="mlp_low_rank"):
+        TpuConfig(batch_size=1, seq_len=64, mlp_low_rank=0)
+    with pytest.raises(ConfigurationError, match="mlp_low_rank"):
+        TpuConfig(batch_size=1, seq_len=64, mlp_low_rank=-4)
+
+
+# ---------------------------------------------------------------------------
+# app-level pins: greedy tokens unchanged at conservative (full) rank,
+# truncated + quant-composed apps decode
+# ---------------------------------------------------------------------------
+
+def test_full_rank_app_greedy_tokens_unchanged():
+    dense = _greedy(_build())
+    # rank 64 == hidden_size == min dim of every MLP projection: exact
+    full = _greedy(_build(mlp_low_rank=64))
+    assert full == dense
+
+
+def test_truncated_and_quantized_low_rank_apps_decode():
+    toks = _greedy(_build(mlp_low_rank=16), n_decode=4)
+    assert len(toks) == 5 and all(0 <= t < 512 for t in toks)
+    toks_q = _greedy(_build(mlp_low_rank=16, quantized=True,
+                            quantization_dtype="int8"), n_decode=4)
+    assert len(toks_q) == 5 and all(0 <= t < 512 for t in toks_q)

@@ -380,8 +380,9 @@ def test_engine_heals_ragged_fault_mid_serve(app):
 # ---------------------------------------------------------------------------
 
 def test_ragged_config_guards(app):
-    """Greedy-only refusal mirrors speculative serving; token_room stays
-    a unified/speculative hook on the plain adapter."""
+    """Unseeded-sampling refusal mirrors speculative serving (seeded
+    sampling is supported; do_sample without stream_seed is not);
+    token_room stays a unified/speculative hook on the plain adapter."""
     import dataclasses
     from neuronx_distributed_inference_tpu.config import \
         OnDeviceSamplingConfig

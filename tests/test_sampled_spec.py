@@ -1,0 +1,301 @@
+"""Sampled speculation on both serving paths (ISSUE 19).
+
+Acceptance pins:
+  (a) seeded sampled speculative streams are BIT-IDENTICAL to seeded
+      eager sampled streams — standalone spec path AND ragged path, good
+      and bad proposers (k=3, accept < 1 via the perturbed proposer);
+  (b) coupled self-drafting still accepts every draft (rate exactly 1.0
+      — draft and verify replay the same position-keyed gumbel draws);
+  (c) the ragged path stays EXACTLY one materialized dispatch per step
+      under sampling;
+  (d) ``shed_speculation`` enter/exit is stream-preserving under
+      sampling (the width-1 verify emits the same coupled draw);
+  (e) the ``spec_draft``/``spec_verify``/``ragged_step`` fault cells
+      re-run under sampling: typed StepFailure, rollback to the last
+      accepted token, a plain retry continues the exact stream;
+  (f) the typed refusal holds on BOTH sides: unseeded ``do_sample``
+      speculation refused (standalone + ragged), seeded accepted, and
+      ``stream_seed`` without ``do_sample`` is a config-level error;
+  (g) spec metrics flow under the ``mode="sampled"`` label.
+
+Everything compares sampled speculative runs against sampled eager runs
+of the SAME app (one tiny-model compile set for the whole module; the
+coupled draws are position-keyed, so every path replays one stream).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu import telemetry
+from neuronx_distributed_inference_tpu.config import (
+    OnDeviceSamplingConfig, TpuConfig)
+from neuronx_distributed_inference_tpu.models.application import \
+    PagedCausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.resilience import (
+    FAULTS, ConfigurationError, StepFailure)
+from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+from neuronx_distributed_inference_tpu.serving.speculation import (
+    PerturbedSelfDraftProposer, SelfDraftProposer)
+from neuronx_distributed_inference_tpu.serving.speculation.verifier import \
+    validate_spec_sampling
+from neuronx_distributed_inference_tpu.telemetry import metrics as tmetrics
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5, rope_theta=10000.0,
+          hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+RNG = np.random.default_rng(23)
+P_A = RNG.integers(1, 500, size=9).tolist()
+P_B = RNG.integers(1, 500, size=12).tolist()
+
+SC = OnDeviceSamplingConfig(do_sample=True, top_k=8, top_p=0.95,
+                            temperature=1.3, stream_seed=11)
+
+
+@pytest.fixture(scope="module")
+def app():
+    tcfg = TpuConfig(batch_size=2, seq_len=64, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_block_kv_layout=True, pa_block_size=8,
+                     pa_num_blocks=24, is_prefix_caching=True,
+                     on_device_sampling_config=SC)
+    a = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
+                                 LlamaFamily)
+    a.init_random_weights(7).init_cache()
+    return a
+
+
+def _stream(app, prompt, n_decode, sid=0, meta=None):
+    """Eager sampled reference: first token + n_decode decode tokens."""
+    eng = PagedEngineAdapter(app)
+    out = [eng.add_requests([sid], [prompt],
+                            meta=None if meta is None else [meta])[sid]]
+    for _ in range(n_decode):
+        out.append(eng.step()[sid])
+    eng.release([sid])
+    return out
+
+
+@pytest.fixture(scope="module")
+def refs(app):
+    return {0: _stream(app, P_A, 11), 1: _stream(app, P_B, 11, sid=1)}
+
+
+def _collect(eng, sids, prompts, want):
+    """Drive an adapter until every stream holds ``want`` tokens. Ragged
+    adapters defer admission (add_requests returns {})."""
+    res = eng.add_requests(sids, prompts)
+    got = {s: ([res[s]] if s in res else []) for s in sids}
+    steps = 0
+    while any(len(got[s]) < want for s in sids):
+        for s, toks in eng.step().items():
+            got[s].extend(toks)
+        steps += 1
+        assert steps < 60, "sampled decode made no progress"
+    return got, steps
+
+
+# ---------------------------------------------------------------------------
+# seeded eager sampling is reproducible and per-request-seeded
+# ---------------------------------------------------------------------------
+
+def test_seeded_eager_reproducible_and_request_seeded(app, refs):
+    assert _stream(app, P_A, 11) == refs[0]    # same seeds -> same stream
+    alt = _stream(app, P_A, 11, meta={"sampling_seed": 5})
+    assert alt != refs[0]          # per-request seed forks the stream
+    assert _stream(app, P_A, 11, meta={"sampling_seed": 5}) == alt
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: standalone spec path — acceptance (a) + (b)
+# ---------------------------------------------------------------------------
+
+def test_sampled_self_draft_matches_eager(app, refs):
+    eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3))
+    got, _ = _collect(eng, [0, 1], [P_A, P_B], 12)
+    st = dict(eng.host_stats)
+    eng.release([0, 1])
+    for s in (0, 1):
+        assert got[s][:12] == refs[s][:12]
+    # coupled verify replays the draft loop's exact draws: accept 1.0
+    assert st["spec_accepted_tokens"] == st["spec_drafted_tokens"] > 0
+
+
+def test_sampled_perturbed_partial_accept_matches_eager(app, refs):
+    """accept < 1: the corrupted draft column can never equal the coupled
+    target draw, so the rate pins at exactly 1/3 — and the emitted stream
+    is STILL the eager sampled stream (the bonus is the coupled
+    resample)."""
+    eng = PagedEngineAdapter(
+        app, speculation=PerturbedSelfDraftProposer(3, corrupt_at=1))
+    got, _ = _collect(eng, [0, 1], [P_A, P_B], 12)
+    st = dict(eng.host_stats)
+    eng.release([0, 1])
+    for s in (0, 1):
+        assert got[s][:12] == refs[s][:12]
+    rate = st["spec_accepted_tokens"] / st["spec_drafted_tokens"]
+    assert rate == pytest.approx(1 / 3)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: ragged path + one materialized dispatch — (a) + (c)
+# ---------------------------------------------------------------------------
+
+def test_sampled_ragged_matches_eager_one_dispatch_per_step(app, refs):
+    eng = PagedEngineAdapter(app, ragged=True,
+                             speculation=SelfDraftProposer(3))
+    base_fetch = eng.host_stats["blocking_fetches"]
+    got, steps = _collect(eng, [0, 1], [P_A, P_B], 12)
+    st = dict(eng.host_stats)
+    eng.release([0, 1])
+    for s in (0, 1):
+        assert got[s][:12] == refs[s][:12]
+    assert st["spec_accepted_tokens"] == st["spec_drafted_tokens"] > 0
+    # EXACTLY one materialized (blocking-fetch) dispatch per ragged step
+    assert st["ragged_dispatches"] == st["ragged_steps"] == steps
+    assert st["blocking_fetches"] - base_fetch == steps
+
+
+def test_sampled_ragged_perturbed_matches_eager(app, refs):
+    eng = PagedEngineAdapter(
+        app, ragged=True,
+        speculation=PerturbedSelfDraftProposer(3, corrupt_at=1))
+    got, _ = _collect(eng, [0, 1], [P_A, P_B], 12)
+    st = dict(eng.host_stats)
+    eng.release([0, 1])
+    for s in (0, 1):
+        assert got[s][:12] == refs[s][:12]
+    rate = st["spec_accepted_tokens"] / st["spec_drafted_tokens"]
+    assert rate == pytest.approx(1 / 3)
+
+
+# ---------------------------------------------------------------------------
+# shed_speculation enter/exit is stream-preserving — acceptance (d)
+# ---------------------------------------------------------------------------
+
+def test_shed_speculation_stream_preserving_under_sampling(app, refs):
+    eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3))
+    res = eng.add_requests([0, 1], [P_A, P_B])
+    got = {s: [res[s]] for s in (0, 1)}
+    n = 0
+    while any(len(got[s]) < 12 for s in (0, 1)):
+        eng.set_speculation_shed(n % 2 == 1)   # toggle every step
+        for s, toks in eng.step().items():
+            got[s].extend(toks)
+        n += 1
+        assert n < 60
+    eng.release([0, 1])
+    for s in (0, 1):
+        assert got[s][:12] == refs[s][:12]
+
+
+# ---------------------------------------------------------------------------
+# fault cells re-run under sampling — acceptance (e)
+# ---------------------------------------------------------------------------
+
+def test_sampled_fault_rollback_and_retry(app, refs):
+    eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3))
+    got = [eng.add_requests([0], [P_A])[0]]
+    got.extend(eng.step()[0])
+    for point in ("spec_draft", "spec_verify"):
+        pos = eng.seqs[0].position
+        blocks = list(app.kv_mgr.tables[0])
+        with pytest.raises(StepFailure) as ei:
+            with FAULTS.inject(point):
+                eng.step()
+        assert ei.value.phase == point
+        assert ei.value.retry_safe
+        assert eng.seqs[0].position == pos
+        assert list(app.kv_mgr.tables[0]) == blocks
+        got.extend(eng.step()[0])              # retry heals the stream
+    eng.release([0])
+    n = min(len(got), len(refs[0]))
+    assert got[:n] == refs[0][:n]
+    assert n >= 9
+
+
+def test_sampled_ragged_fault_rollback_and_retry(app, refs):
+    eng = PagedEngineAdapter(app, ragged=True,
+                             speculation=SelfDraftProposer(3))
+    eng.add_requests([0], [P_A])
+    got = list(eng.step()[0])                  # admission + first tokens
+    with pytest.raises(StepFailure) as ei:
+        with FAULTS.inject("ragged_step"):
+            eng.step()
+    assert ei.value.phase == "ragged"
+    assert ei.value.retry_safe
+    got.extend(eng.step()[0])                  # retry heals the stream
+    eng.release([0])
+    n = min(len(got), len(refs[0]))
+    assert got[:n] == refs[0][:n]
+    assert n >= 5
+
+
+# ---------------------------------------------------------------------------
+# typed refusal, both sides — acceptance (f)
+# ---------------------------------------------------------------------------
+
+def test_unseeded_sampling_refused_seeded_accepted(app):
+    unseeded = dataclasses.replace(
+        app.tpu_config,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=True))
+    orig = app.tpu_config
+    try:
+        app.tpu_config = unseeded
+        with pytest.raises(ConfigurationError, match="SEEDED"):
+            PagedEngineAdapter(app, speculation=SelfDraftProposer(3))
+        with pytest.raises(ConfigurationError, match="SEEDED"):
+            PagedEngineAdapter(app, ragged=True,
+                               speculation=SelfDraftProposer(3))
+    finally:
+        app.tpu_config = orig
+    # the seeded config is accepted on both paths (mode resolves sampled)
+    assert PagedEngineAdapter(
+        app, speculation=SelfDraftProposer(3))._spec.mode == "sampled"
+    assert PagedEngineAdapter(
+        app, ragged=True,
+        speculation=SelfDraftProposer(3))._ragged.mode == "sampled"
+
+
+def test_validate_spec_sampling_modes():
+    assert validate_spec_sampling(None, "x") == "greedy"
+    assert validate_spec_sampling(
+        OnDeviceSamplingConfig(do_sample=False), "x") == "greedy"
+    assert validate_spec_sampling(
+        OnDeviceSamplingConfig(do_sample=True, stream_seed=3),
+        "x") == "sampled"
+    with pytest.raises(ConfigurationError, match="unseeded do_sample"):
+        validate_spec_sampling(OnDeviceSamplingConfig(do_sample=True), "x")
+
+
+def test_stream_seed_requires_do_sample():
+    with pytest.raises(ConfigurationError, match="stream_seed"):
+        TpuConfig(batch_size=1, seq_len=64,
+                  on_device_sampling_config=OnDeviceSamplingConfig(
+                      do_sample=False, stream_seed=3))
+
+
+# ---------------------------------------------------------------------------
+# metrics: the mode="sampled" label — acceptance (g)
+# ---------------------------------------------------------------------------
+
+def test_spec_metrics_sampled_mode_label(app):
+    reg = telemetry.MetricsRegistry()
+    telemetry.set_registry(reg)
+    try:
+        eng = PagedEngineAdapter(app, speculation=SelfDraftProposer(3))
+        eng.add_requests([0], [P_A])
+        eng.step()
+        eng.release([0])
+    finally:
+        telemetry.disable()
+    drafted = reg.get(tmetrics.SPEC_DRAFTED_TOKENS_TOTAL)
+    assert drafted.get(engine="paged", mode="sampled") == 3
+    assert reg.get(tmetrics.SPEC_ACCEPT_RATE).get(
+        engine="paged", mode="sampled") == 1.0

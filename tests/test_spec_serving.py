@@ -473,10 +473,11 @@ def test_spec_metrics_flow(app):
     finally:
         telemetry.disable()
     assert reg.get(tmetrics.SPEC_DRAFTED_TOKENS_TOTAL).get(
-        engine="paged") == 6
+        engine="paged", mode="greedy") == 6
     assert reg.get(tmetrics.SPEC_ACCEPTED_TOKENS_TOTAL).get(
-        engine="paged") == 6
-    assert reg.get(tmetrics.SPEC_ACCEPT_RATE).get(engine="paged") == 1.0
+        engine="paged", mode="greedy") == 6
+    assert reg.get(tmetrics.SPEC_ACCEPT_RATE).get(engine="paged",
+                                                  mode="greedy") == 1.0
     width = reg.get(tmetrics.SPEC_VERIFY_WIDTH)
     assert width.count(engine="paged") == 2
     assert width.sum(engine="paged") == 8.0    # two width-4 dispatches
