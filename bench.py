@@ -630,6 +630,7 @@ def perf_measure():
                        for g in golden["graphs"].values()
                        for c in g["collectives"].values())
     migrations_per_drain, avoided = _measure_migration_proxies()
+    lora_dps, lora_swap_bytes = _measure_lora_proxies()
     return {
         "dispatches_per_step": round(
             (stats["dispatches"] + stats["prefill_dispatches"]) / steps, 3),
@@ -643,6 +644,8 @@ def perf_measure():
         "golden_collective_bytes": golden_bytes,
         "migrations_per_drain": migrations_per_drain,
         "recompute_avoided_tokens": avoided,
+        "lora_dispatches_per_step": lora_dps,
+        "lora_swap_bytes": lora_swap_bytes,
     }
 
 
@@ -701,6 +704,176 @@ def _measure_migration_proxies():
             router.stats["migrated_kv_tokens"])
 
 
+def _lora_bench_setup(n_adapters=4, seed=20):
+    """Tiny LoRA-built paged app + a bounded adapter pool with
+    ``n_adapters`` seeded synthetic adapters registered (more than the
+    pool's device slots, so churn evicts) — shared by the perf-drift
+    proxies and ``--lora-churn``."""
+    from neuronx_distributed_inference_tpu.config import (LoraServingConfig,
+                                                          TpuConfig)
+    from neuronx_distributed_inference_tpu.models.application import \
+        PagedCausalLMApplication
+    from neuronx_distributed_inference_tpu.models.llama import (
+        LlamaFamily, LlamaInferenceConfig)
+    from neuronx_distributed_inference_tpu.serving import LoraAdapterPool
+
+    hf = _tiny_llama_hf()
+    tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_block_kv_layout=True, pa_block_size=8,
+                     pa_num_blocks=40, is_prefix_caching=True,
+                     lora_config=LoraServingConfig(
+                         max_loras=3, max_lora_rank=4,
+                         target_modules=["q_proj", "v_proj"]))
+    app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **hf),
+                                   LlamaFamily)
+    app.init_random_weights(seed=0).init_cache()
+    pool = LoraAdapterPool(app, host_cache_adapters=2)
+    lw = app.params["layers"]
+    nprng = np.random.default_rng(seed)
+    for i in range(n_adapters):
+        arrays = {}
+        for mod in app.spec.lora.target_modules:
+            sa = lw[f"lora_A_{mod}"].shape       # (L, slots, in, r)
+            sb = lw[f"lora_B_{mod}"].shape       # (L, slots, r, out)
+            arrays[mod] = (
+                (nprng.standard_normal((sa[0], sa[2], sa[3]))
+                 * 0.05).astype(np.float32),
+                (nprng.standard_normal((sb[0], sb[2], sb[3]))
+                 * 0.05).astype(np.float32))
+        pool.register_arrays(f"l{i}", arrays)
+    return app, pool
+
+
+def _drive_lora_mixed(app, pool, want=6):
+    """One mixed-adapter ragged serve: three streams under DIFFERENT
+    adapters (l0, l1, base model) through ONE engine adapter. Returns
+    the host-stat deltas, engine steps, and the ragged pad-token
+    counters — the structural evidence that multi-LoRA rides the
+    one-dispatch-per-step unified path."""
+    from neuronx_distributed_inference_tpu.serving import PagedEngineAdapter
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(1, 500, size=n).tolist() for n in (9, 12, 7)]
+    eng = PagedEngineAdapter(app, ragged=True, lora_pool=pool)
+    base = dict(eng.host_stats)
+    eng.add_requests([0, 1, 2], prompts,
+                     meta=[{"adapter": "l0"}, {"adapter": "l1"}, None])
+    got = {s: [] for s in range(3)}
+    steps = 0
+    while any(len(got[s]) < want for s in got):
+        for s, toks in eng.step().items():
+            got[s].extend(toks if isinstance(toks, list) else [toks])
+        steps += 1
+        assert steps < 200, "mixed-adapter workload made no progress"
+    stats = {k: eng.host_stats[k] - base.get(k, 0) for k in eng.host_stats}
+    eng.release(range(3))
+    return stats, steps, got
+
+
+def _lora_churn(pool, trace=("l0", "l0", "l2", "l2", "l0", "l1",
+                             "l1", "l3", "l1", "l0")):
+    """A skewed acquire/release trace over more adapters than device
+    slots: repeated l0/l1/l2 acquires hit warm slots, the cold l2/l3
+    arrivals force LRU evictions (device->host spills) and restores.
+    All counts land in ``pool.stats`` — deterministic on the synthetic
+    adapters."""
+    for nm in trace:
+        pool.acquire(nm)
+        pool.release(nm)
+
+
+def _measure_lora_proxies():
+    """Deterministic multi-LoRA structural proxies (ISSUE 20's
+    perf-drift extension): dispatches per engine step under a
+    MIXED-adapter ragged serve (the one-dispatch pin — rows from
+    different adapters plus base-model rows share every dispatch), and
+    total swap H2D bytes after the serve + a skewed churn trace (exact
+    byte count on the synthetic adapters; gated at 0.0)."""
+    app, pool = _lora_bench_setup()
+    stats, steps, _ = _drive_lora_mixed(app, pool)
+    _lora_churn(pool)
+    dispatches = stats["dispatches"] + stats["prefill_dispatches"]
+    return (round(dispatches / steps, 3), int(pool.stats["swap_bytes"]))
+
+
+def lora_churn_main(artifact_path="artifacts/bench_lora_r20.json"):
+    """CPU-runnable multi-LoRA churn microbench (ISSUE 20): a
+    mixed-adapter ragged serve (adapters l0/l1 + a base-model row in one
+    engine) followed by a skewed adapter churn over MORE adapters than
+    device slots, against the bounded pool (serving/lora_pool.py).
+    Reports residency hit-rate, swap H2D bytes/latency, eviction +
+    spill/restore counts, the dispatches-per-step pin under mixed
+    adapters, ragged pad-waste, and the AOT bytes/flops delta of the
+    lora-augmented unified graph vs the plain ragged graph
+    (telemetry/observatory.py)."""
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (e.g. under a test runner)
+    from neuronx_distributed_inference_tpu.telemetry import observatory
+
+    app, pool = _lora_bench_setup()
+    stats, steps, got = _drive_lora_mixed(app, pool)
+    serve_stats = dict(pool.stats)
+    _lora_churn(pool)
+    ps = pool.stats
+    dispatches = stats["dispatches"] + stats["prefill_dispatches"]
+    pad_waste = round(1.0 - stats["ragged_real_tokens"]
+                      / max(stats["ragged_padded_tokens"], 1), 4)
+    hit_rate = round(ps["hits"] / max(ps["hits"] + ps["misses"], 1), 4)
+    # AOT graph delta: the lora-augmented unified dispatch vs the plain
+    # ragged graph on the SAME app (the per-row (A, B) gather + delta
+    # einsum is the entire difference)
+    graphs = {}
+    for kind, bucket, build in observatory._graph_entries(app):
+        if kind in ("ragged", "ragged_lora"):
+            fn, args, kwargs = build()
+            with app._mesh_ctx():
+                compiled = fn.lower(*args, **kwargs).compile()
+            flops, bytes_acc = observatory._cost(compiled)
+            graphs[kind] = {"bucket": bucket, "flops": flops,
+                            "bytes_accessed": bytes_acc}
+    delta = {
+        "flops": graphs["ragged_lora"]["flops"] - graphs["ragged"]["flops"],
+        "bytes_accessed": (graphs["ragged_lora"]["bytes_accessed"]
+                           - graphs["ragged"]["bytes_accessed"]),
+    }
+    payload = {
+        "metric": "lora_dispatches_per_step_mixed_adapters",
+        "value": round(dispatches / steps, 3),
+        "unit": "dispatches_per_engine_step_mixed_adapter_load",
+        "details": {
+            "engine_steps": steps,
+            "dispatches": dispatches,
+            "tokens": sum(len(v) for v in got.values()),
+            "streams": {"l0": 1, "l1": 1, "base": 1},
+            "ragged_pad_waste": pad_waste,
+            "residency_hit_rate": hit_rate,
+            "swap_bytes": ps["swap_bytes"],
+            "swap_seconds": round(ps["swap_s"], 4),
+            "swaps": ps["swaps"],
+            "cold_loads": ps["cold_loads"],
+            "restores": ps["restores"],
+            "spills": ps["spills"],
+            "evictions": ps["evictions"],
+            "host_evictions": ps["host_evictions"],
+            "serve_only": {k: serve_stats[k]
+                           for k in ("swaps", "swap_bytes", "hits",
+                                     "misses")},
+            "pool": {"device_slots": pool.n_slots,
+                     "registered": len(pool.names),
+                     "host_cache_adapters": pool.max_host},
+            "graphs": graphs,
+            "lora_graph_delta": delta,
+            "model": "llama-tiny 2L/64h (synthetic fp32), rank-4 "
+                     "adapters on q_proj/v_proj",
+            "device": str(jax.devices()[0]),
+        },
+    }
+    _emit_report_artifact(payload, artifact_path, "lora-churn")
+    return 0
+
+
 def perf_snapshot_main(artifact_path="artifacts/perf_baseline_r16.json"):
     """Write the committed perf-drift baseline (ISSUE 16): one
     ``nxdi-perf-baseline-v1`` artifact holding the tracked proxy metrics
@@ -730,6 +903,8 @@ def perf_snapshot_main(artifact_path="artifacts/perf_baseline_r16.json"):
             "golden_collective_bytes": 0.0,
             "migrations_per_drain": 0.0,
             "recompute_avoided_tokens": 0.0,
+            "lora_dispatches_per_step": 0.0,
+            "lora_swap_bytes": 0.0,
         },
         "details": {
             "workload": "bench_ragged mixed load (self-draft k=3, "
@@ -1280,7 +1455,8 @@ def chaos_report_main(artifact_path="artifacts/bench_chaos_r15.json"):
     except Exception:
         pass  # backend already initialized (e.g. under a test runner)
 
-    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.config import (LoraServingConfig,
+                                                          TpuConfig)
     from neuronx_distributed_inference_tpu.models.application import \
         PagedCausalLMApplication
     from neuronx_distributed_inference_tpu.models.llama import (
@@ -1291,12 +1467,18 @@ def chaos_report_main(artifact_path="artifacts/bench_chaos_r15.json"):
     hf = _tiny_llama_hf()
 
     def make_app():
-        # replicas of ONE model: same weights seed on every app
+        # replicas of ONE model: same weights seed on every app.
+        # LoRA-built so the workload's adapter-churn phase traverses the
+        # adapter_swap / adapter_spill fault points (slots start zero —
+        # base streams are bit-identical to a no-LoRA build)
         tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
                          enable_bucketing=True,
                          context_encoding_buckets=[16],
                          is_block_kv_layout=True, pa_block_size=8,
-                         is_prefix_caching=True)
+                         is_prefix_caching=True,
+                         lora_config=LoraServingConfig(
+                             max_loras=3, max_lora_rank=4,
+                             target_modules=["q_proj", "v_proj"]))
         app = PagedCausalLMApplication(None,
                                        LlamaInferenceConfig(tcfg, **hf),
                                        LlamaFamily)
@@ -1669,6 +1851,8 @@ def main():
         return slo_report_main()
     if "--chaos-report" in sys.argv[1:]:
         return chaos_report_main()
+    if "--lora-churn" in sys.argv[1:]:
+        return lora_churn_main()
     if "--graph-report" in sys.argv[1:]:
         return graph_report_main()
     if "--sharding-report" in sys.argv[1:]:

@@ -46,6 +46,7 @@ DEFAULT_PATHS = (
     "neuronx_distributed_inference_tpu/serving/fleet/handoff.py",
     "neuronx_distributed_inference_tpu/serving/fleet/autoscaler.py",
     "neuronx_distributed_inference_tpu/serving/fleet/loadgen.py",
+    "neuronx_distributed_inference_tpu/serving/lora_pool.py",
     "neuronx_distributed_inference_tpu/parallel/collectives.py",
     "neuronx_distributed_inference_tpu/resilience/controller.py",
     "neuronx_distributed_inference_tpu/resilience/chaos.py",

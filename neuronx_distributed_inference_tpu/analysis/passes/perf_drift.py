@@ -35,7 +35,8 @@ BASELINE_SCHEMA = "nxdi-perf-baseline-v1"
 MUST_GATE = ("dispatches_per_step", "materialized_per_step",
              "ragged_pad_waste", "precompile_graphs",
              "golden_collective_bytes", "migrations_per_drain",
-             "recompute_avoided_tokens")
+             "recompute_avoided_tokens", "lora_dispatches_per_step",
+             "lora_swap_bytes")
 
 
 def golden_bytes_total(golden: Dict[str, Any]) -> int:

@@ -132,6 +132,7 @@ class CausalLMApplication:
         quantizes its own factors (modules/low_rank.factorize_params)."""
         from ..modules import quantization as quant
         host = model_base.fuse_qkv_host(host)
+        host = model_base.stack_lora_host(self.spec, host)
         fp_shardings = model_base.param_shardings(self.spec, self.mesh)
         if self.spec.quant is None and self.spec.low_rank is None:
             self.params = ckpt.device_put_params(host, fp_shardings,
@@ -1023,9 +1024,14 @@ class CausalLMApplication:
         self.lora_slots = slots
         return slots
 
-    def set_lora_adapter(self, slot: int, path: str):
-        """Dynamic multi-LoRA: (re)load one adapter dir into ``slot``
-        (reference: host-side adapter swap, models/model_base.py:3349-3356)."""
+    def lora_adapter_arrays(self, path: str) -> Dict[str, Any]:
+        """Load + shard-transform one PEFT adapter dir into the host-side
+        stacked layout: ``{module: (A (L,in,r), B (L,r,out))}`` — the
+        same GQA head pad/replicate transforms the base weights get, so
+        the arrays are slot-writable as-is (:meth:`write_lora_slot`).
+        This is the pure LOAD half of the old ``set_lora_adapter``; the
+        serving adapter pool (serving/lora_pool.py) caches these arrays
+        host-side for spill/restore without re-reading the checkpoint."""
         from ..modules import lora as lora_mod
         from ..parallel.layers import place_q_weight, replicate_kv_weight
         sd, acfg = lora_mod.load_peft_adapter(path)
@@ -1046,16 +1052,32 @@ class CausalLMApplication:
             "k_proj": lambda b: replicate_kv_weight(b, g, D, -1),
             "v_proj": lambda b: replicate_kv_weight(b, g, D, -1),
         }
+        arrays: Dict[str, Any] = {}
         for mod in lo.target_modules:
             d_in, d_out = dims[mod]
             # o_proj's A consumes the padded head layout on its input side
             in_transform = (lambda a: place_q_weight(a, g, D, 0)) \
                 if mod == "o_proj" else None
-            a, b = lora_mod.adapter_layer_arrays(
+            arrays[mod] = lora_mod.adapter_layer_arrays(
                 sd, acfg, self.spec.num_layers, mod, d_in, d_out, lo.rank,
                 out_transform=transforms.get(mod), in_transform=in_transform)
+        return arrays
+
+    def write_lora_slot(self, slot: int, arrays: Dict[str, Any]):
+        """Write pre-transformed adapter ``arrays`` ({module: (A, B)},
+        :meth:`lora_adapter_arrays` layout) into ``slot`` of the stacked
+        device params — the pure WRITE half of adapter loading, so a
+        caller can make the swap transactional by snapshotting the
+        touched leaves first (serving/lora_pool.py does)."""
+        from ..modules import lora as lora_mod
+        for mod, (a, b) in arrays.items():
             lora_mod.set_adapter_slot(self.params, "layers", slot, mod, a, b)
         return self
+
+    def set_lora_adapter(self, slot: int, path: str):
+        """Dynamic multi-LoRA: (re)load one adapter dir into ``slot``
+        (reference: host-side adapter swap, models/model_base.py:3349-3356)."""
+        return self.write_lora_slot(slot, self.lora_adapter_arrays(path))
 
 
 def _flatten_tree(tree: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
@@ -1155,6 +1177,20 @@ class PagedCausalLMApplication(CausalLMApplication):
             return jnp.zeros((batch,), jnp.int32)
         return jnp.asarray(row_seeds, jnp.int32)
 
+    def _lora_adapter_ids(self, adapter_ids):
+        """Gate the per-row LoRA slot input of the paged graph family:
+        None (nothing attached a pool) keeps every graph byte-identical
+        to a LoRA-free build — an absent optional arg is an empty pytree,
+        exactly the ``_stream_seeds`` off-knob pattern. Negative ids
+        clamp to slot 0 (the pinned zero adapter = base model)."""
+        if adapter_ids is None:
+            return None
+        if self.spec.lora is None:
+            raise ValueError(
+                "adapter_ids passed but the model was built without "
+                "lora_config — set TpuConfig.lora_config")
+        return jnp.asarray(np.maximum(np.asarray(adapter_ids, np.int32), 0))
+
     def _jit_paged_loop(self, num_steps: int):
         fn = partial(model_base.paged_decode_loop, self.spec, self.tpu_config,
                      num_steps=num_steps)
@@ -1162,7 +1198,7 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_paged_loop(self, first_tokens, positions, block_table,
                         num_steps: int, sampling_params=None,
-                        row_seeds=None):
+                        row_seeds=None, adapter_ids=None):
         # horizon guard: the fused loop writes KV at positions
         # [p, p+num_steps); past seq_len the in-graph slot advance would
         # index past the block table (mirrors _run_decode_loop's guard)
@@ -1172,13 +1208,17 @@ class PagedCausalLMApplication(CausalLMApplication):
         key = ("paged_loop", num_steps)
         if key not in self._compiled:
             self._compiled[key] = self._jit_paged_loop(num_steps)
+        aids = self._lora_adapter_ids(adapter_ids)
         self._note_jit("paged_loop", num_steps,
-                       (first_tokens.shape[0], block_table.shape[1]))
+                       (first_tokens.shape[0], block_table.shape[1],
+                        aids is not None))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 first_tokens.shape[0])
         seeds = self._stream_seeds(row_seeds, first_tokens.shape[0])
         kw = {"row_seeds": seeds} if seeds is not None else {}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(first_tokens),
@@ -1210,7 +1250,7 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_spec_draft(self, first_tokens, positions, block_table, widths,
                         num_steps: int, sampling_params=None,
-                        row_seeds=None):
+                        row_seeds=None, adapter_ids=None):
         """Masked greedy-k self-draft pass (one fused dispatch; see
         model_base.paged_spec_draft_loop). Frozen rows (width already
         reached) write nothing, so the per-row clamp in ``widths`` bounds
@@ -1221,13 +1261,17 @@ class PagedCausalLMApplication(CausalLMApplication):
         key = ("spec_draft", num_steps)
         if key not in self._compiled:
             self._compiled[key] = self._jit_spec_draft(num_steps)
+        aids = self._lora_adapter_ids(adapter_ids)
         self._note_jit("spec_draft", num_steps,
-                       (first_tokens.shape[0], block_table.shape[1]))
+                       (first_tokens.shape[0], block_table.shape[1],
+                        aids is not None))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 first_tokens.shape[0])
         seeds = self._stream_seeds(row_seeds, first_tokens.shape[0])
         kw = {"row_seeds": seeds} if seeds is not None else {}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(first_tokens),
@@ -1241,7 +1285,8 @@ class PagedCausalLMApplication(CausalLMApplication):
 
     def _run_spec_verify(self, input_ids, position_ids, slot_mapping,
                          block_table, widths, want_hidden: bool = False,
-                         sampling_params=None, row_seeds=None):
+                         sampling_params=None, row_seeds=None,
+                         adapter_ids=None):
         """Speculative verify dispatch: ONE ragged k+1-wide paged forward
         with in-graph exact-match acceptance (model_base.paged_spec_verify
         — greedy argmax, or the coupled sampled draw when the stream-seed
@@ -1254,8 +1299,10 @@ class PagedCausalLMApplication(CausalLMApplication):
         key = ("spec_verify", input_ids.shape[1], want_hidden)
         if key not in self._compiled:
             self._compiled[key] = self._jit_spec_verify(want_hidden)
+        aids = self._lora_adapter_ids(adapter_ids)
         self._note_jit("spec_verify", input_ids.shape[1],
-                       (input_ids.shape, block_table.shape))
+                       (input_ids.shape, block_table.shape,
+                        aids is not None))
         seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
         kw = {}
         if seeds is not None:
@@ -1263,6 +1310,8 @@ class PagedCausalLMApplication(CausalLMApplication):
                 sampling_params = self._default_sampling_params(
                     input_ids.shape[0])
             kw = {"sampling_params": sampling_params, "row_seeds": seeds}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(input_ids),
@@ -1281,7 +1330,7 @@ class PagedCausalLMApplication(CausalLMApplication):
     def _run_ragged(self, input_ids, position_ids, slot_mapping,
                     block_table, widths, emit_modes,
                     want_hidden: bool = False, sampling_params=None,
-                    row_seeds=None):
+                    row_seeds=None, adapter_ids=None):
         """ONE ragged mixed dispatch (model_base.paged_ragged_step): rows
         mix decode steps, prefill chunks and speculative verify windows,
         each at its own offset over its own block table. ``input_ids``
@@ -1294,13 +1343,17 @@ class PagedCausalLMApplication(CausalLMApplication):
         key = ("ragged", input_ids.shape[1], want_hidden)
         if key not in self._compiled:
             self._compiled[key] = self._jit_ragged(want_hidden)
+        aids = self._lora_adapter_ids(adapter_ids)
         self._note_jit("ragged", input_ids.shape[1],
-                       (input_ids.shape, block_table.shape))
+                       (input_ids.shape, block_table.shape,
+                        aids is not None))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(
                 input_ids.shape[0])
         seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
         kw = {"row_seeds": seeds} if seeds is not None else {}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         with self._mesh_ctx():
             out = self._compiled[key](
                 self.params, self.cache, jnp.asarray(input_ids),
@@ -1325,17 +1378,22 @@ class PagedCausalLMApplication(CausalLMApplication):
                                                kind="block_table")
 
     def _run_paged(self, input_ids, position_ids, slot_mapping, block_table,
-                   last_idx, sampling_params=None, row_seeds=None):
+                   last_idx, sampling_params=None, row_seeds=None,
+                   adapter_ids=None):
         t0 = self._tel_start()
         fn = self.get_compiled("paged_forward")
+        aids = self._lora_adapter_ids(adapter_ids)
         # one jitted graph serves every paged call; the shape signature
         # (prefill width x table width) is what distinguishes compiles
         self._note_jit("paged", input_ids.shape[1],
-                       (input_ids.shape, block_table.shape))
+                       (input_ids.shape, block_table.shape,
+                        aids is not None))
         if sampling_params is None:
             sampling_params = self._default_sampling_params(input_ids.shape[0])
         seeds = self._stream_seeds(row_seeds, input_ids.shape[0])
         kw = {"row_seeds": seeds} if seeds is not None else {}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         with self._mesh_ctx():
             out = fn(self.params, self.cache, jnp.asarray(input_ids),
                      jnp.asarray(position_ids), jnp.asarray(slot_mapping),

@@ -638,6 +638,26 @@ def fuse_qkv_host(host: Dict[str, Any]) -> Dict[str, Any]:
     return host
 
 
+def stack_lora_host(spec: DecoderSpec, host: Dict[str, Any]) -> Dict[str, Any]:
+    """Backfill the stacked ``lora_A_<mod>`` / ``lora_B_<mod>`` host
+    leaves a checkpoint never carries: HF state dicts hold BASE weights
+    only — adapters arrive at serving time, swapped into device slots by
+    serving/lora_pool.py — so every load path stacks zeroed
+    ``(L, max_loras, ...)`` factors here (slot 0 IS the pinned zero
+    adapter). No-op without lora_config or when the leaves are already
+    present (init_random_weights, quantized-state round-trips)."""
+    if spec.lora is None:
+        return host
+    specs = decoder_param_specs(spec)
+    for group, d in specs.items():
+        if not isinstance(d, dict) or not isinstance(host.get(group), dict):
+            continue
+        for k, ps in d.items():
+            if k.startswith("lora_") and k not in host[group]:
+                host[group][k] = np.zeros(ps.shape, ps.dtype)
+    return host
+
+
 def param_shardings(spec: DecoderSpec, mesh: Mesh):
     specs = decoder_param_specs(spec)
     return jax.tree.map(lambda ps: NamedSharding(mesh, ps.pspec), specs,
@@ -1862,7 +1882,8 @@ def _coupled_mode(tpu_cfg: TpuConfig, row_seeds) -> bool:
 
 def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                        input_ids, position_ids, slot_mapping, block_table,
-                       last_idx, sampling_params, rng, row_seeds=None):
+                       last_idx, sampling_params, rng, row_seeds=None,
+                       adapter_ids=None):
     """Unified paged-KV step graph (reference:
     modules/kvcache/block_kv_cache_manager.py + the prefix-caching prefill of
     attention_base.py:772-914). One graph covers:
@@ -1881,6 +1902,11 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     positionally coupled draw (``ops/sampling.coupled_sample``) keyed by
     the ABSOLUTE position of the sampled token — the invariant every
     sampled-speculation bit-identity guarantee rests on.
+    adapter_ids (B,) optional per-row LoRA pool slots (serving/lora_pool):
+    each row gathers its own (A, B) factors from the stacked adapter
+    params inside the one dispatch; slot 0 is the pinned zero adapter, so
+    base-model rows stay bit-identical. Absent (None) the traced graph is
+    byte-identical to a LoRA-free build.
     """
     kv_len = block_table.shape[1] * cache["k"].shape[2]
     ai = attn_inputs(spec, position_ids, lambda w, c=0: attn_ops.decode_mask(
@@ -1888,7 +1914,8 @@ def paged_forward_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
-        "paged", slot_mapping=slot_mapping, block_table=block_table)
+        "paged", slot_mapping=slot_mapping, block_table=block_table,
+        adapter_ids=adapter_ids)
     idx = last_idx[:, None, None].astype(jnp.int32)
     last_h = jnp.take_along_axis(hidden, idx, axis=1)
     logits = _lm_head(spec, params, last_h)[:, 0, :]
@@ -2030,7 +2057,8 @@ def decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 
 def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                      first_tokens, position_ids, block_table,
-                     sampling_params, rng, num_steps: int, row_seeds=None):
+                     sampling_params, rng, num_steps: int, row_seeds=None,
+                     adapter_ids=None):
     """Fused multi-token PAGED decode: ``num_steps`` steps in one device
     call with ZERO per-token host work — slot mappings are computed
     IN-GRAPH from the (pre-extended) block tables, exactly the reference's
@@ -2051,7 +2079,7 @@ def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
             spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
             pos[:, None], slot[:, None], block_table,
             jnp.zeros((b,), jnp.int32), sampling_params, step_rng,
-            row_seeds=row_seeds)
+            row_seeds=row_seeds, adapter_ids=adapter_ids)
         return (out["tokens"], pos + 1, out["cache"]), out["tokens"]
 
     rngs = jax.random.split(rng, num_steps)
@@ -2063,7 +2091,7 @@ def paged_decode_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
                           cache, first_tokens, position_ids, block_table,
                           widths, sampling_params, rng, num_steps: int,
-                          row_seeds=None):
+                          row_seeds=None, adapter_ids=None):
     """Masked greedy-k SELF-DRAFT loop over the paged cache — the
     always-available proposer of speculative serving (serving/speculation/):
     the target model drafts its own continuation through ``num_steps``
@@ -2098,7 +2126,7 @@ def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
             spec, replace_output_logits(tpu_cfg), params, cch, tok[:, None],
             pos[:, None], slot[:, None], block_table,
             jnp.zeros((b,), jnp.int32), sampling_params, step_rng,
-            row_seeds=row_seeds)
+            row_seeds=row_seeds, adapter_ids=adapter_ids)
         ntok = jnp.where(valid, out["tokens"], tok)
         npos = jnp.where(valid, pos + 1, pos)
         return (ntok, npos, out["cache"]), ntok
@@ -2113,7 +2141,7 @@ def paged_spec_draft_loop(spec: DecoderSpec, tpu_cfg: TpuConfig, params,
 def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                       input_ids, position_ids, slot_mapping, block_table,
                       widths, sampling_params=None, row_seeds=None,
-                      want_hidden: bool = False):
+                      want_hidden: bool = False, adapter_ids=None):
     """Speculative VERIFY graph over the paged layout: score all candidate
     positions in ONE ragged multi-token dispatch and compute greedy
     acceptance in-graph (reference acceptance: the cumsum-of-mismatch
@@ -2158,7 +2186,8 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
-        "paged", slot_mapping=slot_mapping, block_table=block_table)
+        "paged", slot_mapping=slot_mapping, block_table=block_table,
+        adapter_ids=adapter_ids)
     logits = _lm_head(spec, params, hidden)
     if _coupled_mode(tpu_cfg, row_seeds):
         # the same coupled draw the eager paged step applies at each
@@ -2193,7 +2222,8 @@ def paged_spec_verify(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
 def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
                       input_ids, position_ids, slot_mapping, block_table,
                       widths, emit_modes, sampling_params, rng,
-                      row_seeds=None, want_hidden: bool = False):
+                      row_seeds=None, want_hidden: bool = False,
+                      adapter_ids=None):
     """The RAGGED UNIFIED dispatch: ONE mixed paged forward whose rows mix
     decode steps (width 1), prefill chunks (width n, positions at the
     row's own suffix offset) and speculative verify windows (width k+1)
@@ -2228,6 +2258,12 @@ def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
         :func:`paged_spec_verify` for why exact match IS rejection
         sampling under the shared positional noise).
 
+    adapter_ids (B,) optional per-row LoRA pool slots: each row gathers
+    its own stacked (A, B) factors in-graph (``modules/lora.lora_delta``),
+    so ONE dispatch mixes rows from different adapters — slot 0 is the
+    pinned zero adapter (base-model rows bit-identical), and leaving the
+    argument absent keeps the graph byte-identical to a LoRA-free build.
+
     Returns tokens (B, W) (emitted prefix, 0 past ``num_emitted``),
     num_emitted (B,), cache (+ hidden (B, W, H) when ``want_hidden`` —
     Medusa/EAGLE proposers feed on the verified features).
@@ -2242,7 +2278,8 @@ def paged_ragged_step(spec: DecoderSpec, tpu_cfg: TpuConfig, params, cache,
     hidden = _embed(spec, params, input_ids, position_ids)
     hidden, new_cache, _ = run_layers(
         spec, params, cache, hidden, ai, None, position_ids,
-        "paged", slot_mapping=slot_mapping, block_table=block_table)
+        "paged", slot_mapping=slot_mapping, block_table=block_table,
+        adapter_ids=adapter_ids)
     logits = _lm_head(spec, params, hidden)
     coupled = _coupled_mode(tpu_cfg, row_seeds)
     if coupled:

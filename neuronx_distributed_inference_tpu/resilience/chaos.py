@@ -398,6 +398,62 @@ class ChaosCampaign:
                 for sid in list(app.kv_mgr.tables):
                     app.kv_mgr.end_sequence(sid)
             detach_hooks()
+
+        # ---- phase 3: multi-LoRA adapter churn (app_a's pool) ----------
+        # A bounded pool over MORE registered adapters than device slots:
+        # one adapter-tagged ragged stream (the adapter_swap point fires
+        # inside the transactional swap of its admission; a trip rolls
+        # the admission back and plain retry heals it) followed by an
+        # acquire/release churn that forces >= 3 evictions, so the
+        # best-effort adapter_spill point is traversed repeatedly (a
+        # trip is swallowed — the later re-acquire cold-loads instead of
+        # restoring, bit-identical either way).
+        if getattr(app_a.spec, "lora", None) is not None:
+            import numpy as np
+
+            from ..serving import LoraAdapterPool
+            pool = LoraAdapterPool(app_a, host_cache_adapters=2)
+            lw = app_a.params["layers"]
+            nprng = np.random.default_rng(self.seed + 31)
+
+            def adapter_arrays():
+                arrs = {}
+                for mod in app_a.spec.lora.target_modules:
+                    sa = lw[f"lora_A_{mod}"].shape   # (L, slots, in, r)
+                    sb = lw[f"lora_B_{mod}"].shape   # (L, slots, r, out)
+                    arrs[mod] = (
+                        (nprng.standard_normal((sa[0], sa[2], sa[3]))
+                         * 0.05).astype(np.float32),
+                        (nprng.standard_normal((sb[0], sb[2], sb[3]))
+                         * 0.05).astype(np.float32))
+                return arrs
+
+            for i in range(pool.n_slots + 2):
+                pool.register_arrays(f"l{i}", adapter_arrays())
+            lora_ad = PagedEngineAdapter(app_a, ragged=True,
+                                         lora_pool=pool)
+            p_lora = self._prompt(rng, bs + 1)
+            try:
+                _retrying(lambda: lora_ad.add_requests(
+                    [900], [p_lora], meta=[{"adapter": "l0"}]))
+                toks_l: List[int] = []
+                for _ in range(self.max_passes):
+                    if len(toks_l) >= max_new:
+                        break
+                    out = _retrying(lambda: lora_ad.step([900]))
+                    toks_l.extend(out.get(900, ()))
+                lora_ad.release([900])
+                results["lora"] = {"tokens": toks_l, "reason": "length"}
+                names = [f"l{1 + i % (pool.n_slots + 1)}"
+                         for i in range(2 * (pool.n_slots + 1))]
+                for nm in names:
+                    _retrying(lambda nm=nm: pool.acquire(nm))
+                    pool.release(nm)
+                stats["lora_swaps"] = pool.stats["swaps"]
+                stats["lora_spills"] = pool.stats["spills"]
+            finally:
+                if 900 in app_a.kv_mgr.tables:
+                    app_a.kv_mgr.end_sequence(900)
         return results
 
     def _drive(self, router, streams: Dict[str, Any],

@@ -22,6 +22,11 @@ burn_index`:
     additionally drops the ragged unified dispatch back to the
     two-phase path (``set_ragged_shed``), trading dispatch fusion for
     the smaller, older graphs.
+  * ``shed_adapters`` (opt-in, ``shed_adapters=True``) — decode-side
+    burn additionally admits NEW LoRA-tagged requests as base-model
+    rows (``set_adapter_shed`` — no pool acquires, zero swap H2D
+    traffic while burning; running rows finish under their pinned
+    adapter, shed streams' meta annotated ``lora_shed=True``).
 
 Every action is **hysteresis-guarded**: it enters when the tenant's
 multiwindow burn (min of short/long — both must burn) crosses
@@ -48,7 +53,8 @@ __all__ = ["DEGRADE_ACTIONS", "DegradationController"]
 
 #: Stable action names (label values of ``nxdi_degraded`` and the
 #: ``degrade.*`` events).
-DEGRADE_ACTIONS = ("shed_speculation", "tighten_admission", "drop_ragged")
+DEGRADE_ACTIONS = ("shed_speculation", "tighten_admission", "drop_ragged",
+                   "shed_adapters")
 
 #: SLO signals that implicate the DECODE path (shed speculation /
 #: ragged) vs the admission path (tighten the tenant's weight).
@@ -64,12 +70,16 @@ class DegradationController:
     (flap damping). ``admission_scale`` is the effective-weight factor
     applied to a tenant while ``tighten_admission`` is active.
     ``drop_ragged=True`` additionally drops a ragged adapter to the
-    two-phase path while decode-side burn is active."""
+    two-phase path while decode-side burn is active.
+    ``shed_adapters=True`` additionally admits new LoRA-tagged requests
+    as base-model rows while decode-side burn is active (same hysteresis
+    band; best-effort tenants trade adapter output for headroom)."""
 
     def __init__(self, *, enter_burn: Optional[float] = None,
                  exit_burn: float = 1.0, min_hold_s: float = 1.0,
                  admission_scale: float = 0.25,
                  drop_ragged: bool = False,
+                 shed_adapters: bool = False,
                  min_interval_s: float = 0.0):
         if enter_burn is not None and enter_burn <= 0:
             raise ConfigurationError("enter_burn must be > 0")
@@ -92,6 +102,7 @@ class DegradationController:
         self.min_hold_s = min_hold_s
         self.admission_scale = admission_scale
         self.drop_ragged = drop_ragged
+        self.shed_adapters = shed_adapters
         # evaluation throttle: burn_index rescans the rolling windows
         # (bounded, but per pass adds up in a tight serving loop) — a
         # production deployment sets e.g. short_window_s / 10; 0 (the
@@ -172,6 +183,9 @@ class DegradationController:
                 if self.drop_ragged:
                     desired[("drop_ragged", tenant)] = max(
                         burn, desired.get(("drop_ragged", tenant), 0.0))
+                if self.shed_adapters:
+                    desired[("shed_adapters", tenant)] = max(
+                        burn, desired.get(("shed_adapters", tenant), 0.0))
             else:
                 desired[("tighten_admission", tenant)] = burn
         # enter: both windows burn past the enter threshold
@@ -198,6 +212,8 @@ class DegradationController:
             adapter.set_speculation_shed(self.is_active("shed_speculation"))
         if hasattr(adapter, "set_ragged_shed"):
             adapter.set_ragged_shed(self.is_active("drop_ragged"))
+        if hasattr(adapter, "set_adapter_shed"):
+            adapter.set_adapter_shed(self.is_active("shed_adapters"))
         queue = engine.queue
         tightened = {t for a, t in self._active if a == "tighten_admission"}
         # re-assert the scale for every ACTIVE tenant (idempotent, like

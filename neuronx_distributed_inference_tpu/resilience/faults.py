@@ -77,6 +77,20 @@ Fault points (a STABLE contract, like the telemetry metric names):
                      (serving/fleet/autoscaler.py) — a trip aborts that
                      evaluation (no spawn, no retire) with the fleet
                      unchanged; serving is never disturbed
+  ``adapter_swap``   the device write of a LoRA adapter swap
+                     (serving/lora_pool.py) — fires AFTER the pre-swap
+                     snapshot and BEFORE the stacked-slot write, so the
+                     transactional rollback (every touched stacked leaf
+                     restored, slot returned to the free list, no
+                     resident slot corrupted) is provable; surfaces as a
+                     retry-safe typed :class:`~.errors.StepFailure`
+                     (``phase="adapter_swap"``), so retry heals
+  ``adapter_spill``  the device→host copy of an evicted adapter slot's
+                     (A,B) factors into the pool's bounded host cache —
+                     spills are best-effort: a trip is swallowed and
+                     counted (``pool.stats["spill_errors"]``), never
+                     failing the acquisition whose eviction triggered it
+                     (the re-acquire just pays a cold checkpoint load)
 
 Hot-path cost while nothing is armed: a single attribute check
 (``FAULTS.active``) — no call, no allocation (pinned by
@@ -96,7 +110,8 @@ FAULT_POINTS = ("paged_alloc", "prefill_step", "prefill_chunk",
                 "decode_step", "slow_step", "pipeline_flush",
                 "spec_draft", "spec_verify", "ragged_step",
                 "kv_spill", "kv_restore", "handoff",
-                "migrate_capture", "migrate_admit", "autoscale")
+                "migrate_capture", "migrate_admit", "autoscale",
+                "adapter_swap", "adapter_spill")
 
 
 class InjectedFault(RuntimeError):

@@ -16,6 +16,7 @@ README "Ragged dispatch") explicitly from ``.ragged``.
 
 from .adapter import (ContinuousBatchingAdapter, PagedEngineAdapter,
                       _EngineAdapterBase)
+from .lora_pool import LoraAdapterPool
 
-__all__ = ["ContinuousBatchingAdapter", "PagedEngineAdapter",
-           "_EngineAdapterBase"]
+__all__ = ["ContinuousBatchingAdapter", "LoraAdapterPool",
+           "PagedEngineAdapter", "_EngineAdapterBase"]

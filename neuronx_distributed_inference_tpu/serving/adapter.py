@@ -201,6 +201,19 @@ def _meta_seed(meta: Any) -> int:
         return 0
 
 
+def _meta_adapter(meta: Any) -> Optional[str]:
+    """Named LoRA adapter from an opaque per-request ``meta`` payload: the
+    serving engine passes mappings with an "adapter" key; everything else
+    (including the non-engine default None, and empty/None values) means
+    the base model. The adapter resolves the name against its attached
+    :class:`~.lora_pool.LoraAdapterPool` at admission."""
+    try:
+        name = meta.get("adapter", None)
+    except AttributeError:
+        return None
+    return str(name) if name else None
+
+
 def _common_tenant(tenants) -> str:
     """The single tenant shared by every affected row, or "" when the set
     is empty or mixed — per-call failure counters label with ONE tenant,
@@ -601,7 +614,8 @@ class _PagedScratch:
     the buffers a still-in-flight dispatch aliases are never rewritten."""
 
     def __init__(self, live: Sequence[int], pad_to: int, width: int,
-                 block_size: int, seeds: Optional[Sequence[int]] = None):
+                 block_size: int, seeds: Optional[Sequence[int]] = None,
+                 aids: Optional[Sequence[int]] = None):
         b = len(live)
         self.live = tuple(live)
         self.b = b
@@ -616,6 +630,16 @@ class _PagedScratch:
             self.seeds[:b] = np.asarray(seeds, np.int32)
             if pad_to > b:
                 self.seeds[b:] = self.seeds[0]
+        # per-row LoRA adapter slots are constants of the live composition
+        # too (a slot is pinned for the sequence's whole residency), so
+        # the buffer is immutable after init like ``seeds``; None keeps
+        # the no-adapter graphs byte-identical (the kwarg is never passed)
+        self.aids = None
+        if aids is not None:
+            self.aids = np.zeros((pad_to,), np.int32)
+            self.aids[:b] = np.asarray(aids, np.int32)
+            if pad_to > b:
+                self.aids[b:] = self.aids[0]
         self._bufs = [(np.empty((pad_to, 1), np.int32),
                        np.empty((pad_to, 1), np.int32),
                        np.empty((pad_to, 1), np.int32),
@@ -1286,7 +1310,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
                  prefill_chunk_tokens: Optional[int] = None,
                  prefill_budget_tokens: Optional[int] = None,
                  speculation=None, kv_spill_tier=None,
-                 ragged: bool = False):
+                 ragged: bool = False, lora_pool=None):
         cfg = app.tpu_config
         if not cfg.is_block_kv_layout:
             raise ConfigurationError("app must be built with "
@@ -1328,6 +1352,22 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self.host_stats["kv_restored_blocks"] = 0
         if kv_spill_tier is not None:
             app.kv_mgr.set_spill_hook(self._spill_block)
+        # multi-LoRA adapter pool (serving/lora_pool.py, README "Multi-LoRA
+        # serving"): per-request adapter names (meta "adapter" key) resolve
+        # to pinned device slots at admission; every dispatch then carries
+        # per-row adapter_ids so ONE step mixes rows from different
+        # adapters (dispatches/step unchanged)
+        if lora_pool is not None and lora_pool.app is not app:
+            raise ConfigurationError(
+                "lora_pool must be built over THIS adapter's application "
+                "(its stacked slots back the per-row gather)")
+        self._lora_pool = lora_pool
+        self._lora_slots: Dict[int, int] = {}   # seq_id -> pinned slot
+        self._lora_names: Dict[int, str] = {}
+        self._adapter_shed = False
+        if lora_pool is not None:
+            self.host_stats["lora_rows"] = 0
+            self.host_stats["lora_shed_requests"] = 0
         if speculation is not None:
             # deferred import: speculation/ imports this module
             from .speculation import SelfDraftProposer
@@ -1430,6 +1470,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
                     prompt=prompt, done=int(c),
                     admit_idx=self._admit_counter, t0=t0,
                     deadline=deadlines[i], meta=metas[i])
+                self._bind_adapter(sid, metas[i])
         except ServingError:
             self._rollback_admission(begun)
             raise
@@ -1483,6 +1524,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
             proposer.forget(seq_ids)
         for sid in seq_ids:
             self._ready.pop(sid, None)
+            self._lora_release(sid)
             if sid in self._chunks:
                 # mid-prefill: blocks whose content never fully landed
                 # must not survive as prefix-cache hits
@@ -1606,6 +1648,21 @@ class PagedEngineAdapter(_EngineAdapterBase):
         Reversible; a no-op without ``ragged=True``."""
         self._ragged_shed = bool(shed)
 
+    @property
+    def adapter_shed(self) -> bool:
+        return self._adapter_shed
+
+    def set_adapter_shed(self, shed: bool) -> None:
+        """Degradation-controller actuator: admit NEW adapter-tagged
+        requests as base-model rows — no pool acquire, so the degraded
+        engine spends zero swap H2D traffic and zero adapter-churn risk
+        while burning. Already-running rows keep their pinned slots and
+        finish under their adapter (a mid-stream model switch would be
+        worse than the overload); shed admissions get their meta mapping
+        annotated ``lora_shed=True`` so consumers can tell the degraded
+        streams apart. Reversible; a no-op without a lora_pool."""
+        self._adapter_shed = bool(shed)
+
     def _proposer_of_path(self):
         if self._spec is not None:
             return self._spec.proposer
@@ -1640,7 +1697,8 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 or scr.width != width):
             scr = self._scratch = _PagedScratch(
                 live, pad_to, width, app.kv_mgr.spec.block_size,
-                seeds=[_meta_seed(self.seqs[s].meta) for s in live])
+                seeds=[_meta_seed(self.seqs[s].meta) for s in live],
+                aids=self._lora_aids(live))
         return scr
 
     def _dispatch_decode(self, scr: _PagedScratch, toks_dev=None):
@@ -1649,15 +1707,18 @@ class PagedEngineAdapter(_EngineAdapterBase):
         previous dispatch's on-device tokens (pipelined feedback); None =
         host tokens from the scratch buffer."""
         ids = scr.ids if toks_dev is None else toks_dev
+        kw = {"row_seeds": scr.seeds}
+        if scr.aids is not None:
+            kw["adapter_ids"] = scr.aids
         if self.app._steady_state:
             # attribute any unexpected recompile to the batched requests'
             # trace lanes (serving/warmup.py steady-state discipline)
             with self.app.request_context(self._traces_of(scr.live)):
                 out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
-                                          scr.last, row_seeds=scr.seeds)
+                                          scr.last, **kw)
         else:
             out = self.app._run_paged(ids, scr.pos, scr.slots, scr.bt,
-                                      scr.last, row_seeds=scr.seeds)
+                                      scr.last, **kw)
         _async_fetch(out["tokens"])
         self.host_stats["dispatches"] += 1
         self.host_stats["device_steps"] += 1
@@ -1686,11 +1747,19 @@ class PagedEngineAdapter(_EngineAdapterBase):
             first[i] = st.last_token
             pos[i] = st.position
             seeds[i] = _meta_seed(st.meta)
+        aids = self._lora_aids(live)
+        if aids is not None:
+            aids = np.asarray(aids, np.int32)
         if pad_to > b:
             first = _repeat_row0(first, pad_to)
             pos = _repeat_row0(pos, pad_to)
             bt = _repeat_row0(bt, pad_to)
             seeds = _repeat_row0(seeds, pad_to)
+            if aids is not None:
+                aids = _repeat_row0(aids, pad_to)
+        kw = {"row_seeds": seeds}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         cache_before = app.cache
         try:
             if _FAULTS.active:
@@ -1698,10 +1767,9 @@ class PagedEngineAdapter(_EngineAdapterBase):
             if app._steady_state:
                 with app.request_context(self._traces_of(live)):
                     out = app._run_paged_loop(first, pos, bt, num_steps,
-                                              row_seeds=seeds)
+                                              **kw)
             else:
-                out = app._run_paged_loop(first, pos, bt, num_steps,
-                                          row_seeds=seeds)
+                out = app._run_paged_loop(first, pos, bt, num_steps, **kw)
             self.host_stats["dispatches"] += 1
             self.host_stats["device_steps"] += num_steps
             rec = _get_recorder()
@@ -1763,9 +1831,17 @@ class PagedEngineAdapter(_EngineAdapterBase):
             "preempted_uncollected": [int(r.seq_id) for r in self.preempted],
             "ragged": self._ragged is not None,
         })
+        if self._lora_pool is not None:
+            state["lora"] = {
+                "rows": {int(s): int(slot)
+                         for s, slot in self._lora_slots.items()},
+                "shed": self._adapter_shed,
+                "pool": self._lora_pool.debug_state(),
+            }
         return state
 
-    def prefix_warmth(self, prompt: Sequence[int]) -> int:
+    def prefix_warmth(self, prompt: Sequence[int],
+                      adapter: Optional[str] = None) -> int:
         """READ-ONLY probe: how many leading tokens of ``prompt`` an
         admission right now would serve from the prefix cache. Peeks the
         :class:`~..modules.block_kv_cache.BlockKVCacheManager` hash state
@@ -1777,7 +1853,16 @@ class PagedEngineAdapter(_EngineAdapterBase):
         token always runs to produce the first sample). With a host KV
         spill tier attached, consecutive spilled full blocks past the
         device hit count as warm too (an admission would restore, not
-        recompute, them) — the fleet router's affinity signal."""
+        recompute, them) — the fleet router's affinity signal.
+
+        ``adapter`` (optional, the request's named LoRA adapter) extends
+        the signal with adapter residency: when a pool is attached and
+        the adapter is already device-resident, the admission saves one
+        swap's worth of H2D traffic, valued as
+        ``prefill_chunk_tokens`` warm tokens (a swap stall is on the
+        order of a chunk dispatch) so the router lands a tenant's
+        requests where their adapter lives. Read-only both ways — the
+        residency probe never touches the pool's LRU order."""
         from ..modules.block_kv_cache import cut_cached_at_unwritten
         cached, blocks = self.app.kv_mgr.probe_cached_tokens(prompt)
         if cached and self._unwritten:
@@ -1786,7 +1871,11 @@ class PagedEngineAdapter(_EngineAdapterBase):
                 self._unwritten)
         if self._kv_tier is not None:
             cached = self._tier_warmth(prompt, cached)
-        return min(cached, len(prompt) - 1)
+        warmth = min(cached, len(prompt) - 1)
+        if (adapter is not None and self._lora_pool is not None
+                and self._lora_pool.resident(adapter)):
+            warmth += self.prefill_chunk_tokens
+        return warmth
 
     # -- host-RAM KV spill tier (serving/fleet/kv_tier.py) -----------------
     def _spill_block(self, blk: int, content_hash: bytes) -> None:
@@ -1883,6 +1972,52 @@ class PagedEngineAdapter(_EngineAdapterBase):
         self.app.cache = {"k": cache["k"].at[:, idx].set(k),
                           "v": cache["v"].at[:, idx].set(v)}
 
+    # -- multi-LoRA adapter pool (serving/lora_pool.py) --------------------
+    def _bind_adapter(self, sid: int, meta: Any) -> None:
+        """Resolve a request's named adapter (meta "adapter" key) to a
+        pinned device slot at admission. With the ``shed_adapters``
+        degradation actuator engaged the request is admitted as a
+        base-model row instead — no acquire, no swap H2D traffic — and
+        its meta mapping is annotated ``lora_shed=True`` so the stream's
+        consumer can tell the degraded output apart. A typed acquire
+        failure (CapacityError: every slot pinned; StepFailure: the swap
+        itself failed, rolled back) propagates into the admission's
+        transactional rollback — nothing is admitted."""
+        if self._lora_pool is None:
+            return
+        name = _meta_adapter(meta)
+        if name is None:
+            return
+        if self._adapter_shed:
+            self.host_stats["lora_shed_requests"] += 1
+            try:
+                meta["lora_shed"] = True
+            except TypeError:
+                pass
+            return
+        slot = self._lora_pool.acquire(name)
+        self._lora_slots[sid] = slot
+        self._lora_names[sid] = name
+        self.host_stats["lora_rows"] += 1
+
+    def _lora_release(self, sid: int) -> None:
+        """Unpin ``sid``'s adapter slot (release / preemption / admission
+        rollback). Idempotent — rollback paths release blindly."""
+        name = self._lora_names.pop(sid, None)
+        if name is not None:
+            self._lora_slots.pop(sid, None)
+            self._lora_pool.release(name)
+
+    def _lora_aids(self, sids) -> Optional[List[int]]:
+        """Per-row device slots for a dispatch, or None without a pool —
+        the adapter_ids kwarg is only ever passed when a pool is
+        attached, keeping no-pool graphs byte-identical. Base-model rows
+        (no adapter, or admitted shed) gather slot 0, the pinned zero
+        adapter."""
+        if self._lora_pool is None:
+            return None
+        return [self._lora_slots.get(s, 0) for s in sids]
+
     # -- preemption -------------------------------------------------------
     def preempt(self, seq_id: int, reason: str = "scheduler") -> Preempted:
         """Scheduler-driven eviction of one running or pending sequence:
@@ -1923,6 +2058,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
 
     def _preempt(self, victim: int, reason: str):
         self._ready.pop(victim, None)      # replay regenerates it
+        self._lora_release(victim)         # requeue re-acquires via meta
         proposer = self._active_proposer
         if proposer is not None:
             # stateful proposers (Medusa/EAGLE) must not carry the
@@ -2020,6 +2156,7 @@ class PagedEngineAdapter(_EngineAdapterBase):
         for sid in reversed(list(seq_ids)):
             self._chunks.pop(sid, None)
             self._ready.pop(sid, None)
+            self._lora_release(sid)
             if self.seqs.pop(sid, None) is not None:
                 self._scratch = None
             self._abort_pending(sid)
@@ -2188,12 +2325,17 @@ class PagedEngineAdapter(_EngineAdapterBase):
         slots = slots_from_table(bt, slot_pos, app.kv_mgr.spec.block_size)
         seeds = np.asarray([_meta_seed(self._chunks[s].meta) for s in sids],
                            np.int32)
+        aids = self._lora_aids(sids)
+        if aids is not None:
+            aids = np.asarray(aids, np.int32)
         pad_to = autobucketing.get_target_bucket(app.batch_buckets, b,
                                                  kind="batch")
         if pad_to > b:
             seeds = _repeat_row0(seeds, pad_to)
+            if aids is not None:
+                aids = _repeat_row0(aids, pad_to)
         return _pad_paged_rows(pad_to, ids_w, pos_w, slots, bt, last) \
-            + (seeds,)
+            + (seeds, aids)
 
     def _dispatch_prefill_chunk(self, packed, fetch: bool = True):
         """Issue ONE packed prefill-chunk dispatch without materializing
@@ -2201,9 +2343,11 @@ class PagedEngineAdapter(_EngineAdapterBase):
         chunk token fetch happens in the caller, one async hop behind.
         ``fetch=False`` (intermediate-only dispatch) skips even the async
         device-to-host copy: those samples are never read."""
-        ids_p, pos_p, slots_p, bt_p, last_p, seeds_p = packed
-        out = self.app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p,
-                                  row_seeds=seeds_p)
+        ids_p, pos_p, slots_p, bt_p, last_p, seeds_p, aids_p = packed
+        kw = {"row_seeds": seeds_p}
+        if aids_p is not None:
+            kw["adapter_ids"] = aids_p
+        out = self.app._run_paged(ids_p, pos_p, slots_p, bt_p, last_p, **kw)
         if fetch:
             _async_fetch(out["tokens"])
         self.host_stats["prefill_dispatches"] += 1

@@ -147,7 +147,8 @@ class ServingEngine:
                deadline_s: Optional[float] = None,
                stop_tokens: Sequence[int] = (),
                request_id: Optional[str] = None,
-               trace_id: Optional[str] = None) -> TokenStream:
+               trace_id: Optional[str] = None,
+               adapter: Optional[str] = None) -> TokenStream:
         """Enqueue one request; returns its :class:`TokenStream`
         immediately (no device work happens here). Raises the typed
         :class:`~...resilience.errors.QueueOverflow` when the queue is at
@@ -159,7 +160,13 @@ class ServingEngine:
         fresh one. The id rides ``meta["trace"]`` through the adapter,
         ``Preempted`` records and handoffs, so one trace follows the
         request across preemptions and replicas (see
-        telemetry/request_trace.py)."""
+        telemetry/request_trace.py).
+
+        ``adapter`` names the request's LoRA adapter: it rides
+        ``meta["adapter"]`` to the paged adapter, which resolves it to a
+        pinned device slot at admission (README "Multi-LoRA serving") —
+        no-op for engines without a lora_pool (the key is simply never
+        read)."""
         if self._closed:
             raise ServingError("engine is closed")
         tokens = [int(t) for t in tokens]
@@ -191,6 +198,8 @@ class ServingEngine:
             stop_tokens=frozenset(int(t) for t in stop_tokens),
             meta={"request_id": rid, "tenant": tenant,
                   "priority": priority, "trace": tid})
+        if adapter is not None:
+            req.meta["adapter"] = str(adapter)
         self.queue.push(req)         # may raise QueueOverflow
         stream._cancel_cb = lambda: self.cancel(rid)
         self.stats["submitted"] += 1
@@ -242,7 +251,8 @@ class ServingEngine:
             tenant=str(meta.get("tenant", "default")),
             priority=int(meta.get("priority", 0)),
             deadline_s=kw["deadline_s"][0], stop_tokens=stop_tokens,
-            request_id=request_id, trace_id=trace_of(meta))
+            request_id=request_id, trace_id=trace_of(meta),
+            adapter=meta.get("adapter"))
         if self.slo is not None and rec.n_generated > 0:
             # a continuation: the CLIENT saw its first token long ago on
             # the failed replica — this engine's first delivery must not

@@ -219,11 +219,18 @@ class EngineRouter:
                tenant: str = "default", priority: int = 0,
                deadline_s: Optional[float] = None,
                stop_tokens: Sequence[int] = (),
-               request_id: Optional[str] = None) -> TokenStream:
+               request_id: Optional[str] = None,
+               adapter: Optional[str] = None) -> TokenStream:
         """Route one request to the warmest healthy replica and return
         its fleet-level :class:`TokenStream` (tokens survive replica
         failovers). Raises :class:`ReplicaUnavailable` when no replica is
-        healthy; replica-side admission errors propagate unchanged."""
+        healthy; replica-side admission errors propagate unchanged.
+
+        ``adapter`` names the request's LoRA adapter (README "Multi-LoRA
+        serving"): it rides ``meta["adapter"]`` to the replica's engine
+        AND extends the affinity score — a replica whose pool already has
+        the adapter device-resident scores warmer, so a tenant's requests
+        land where their adapter lives instead of forcing a swap."""
         tokens = [int(t) for t in tokens]
         rid = (request_id if request_id is not None
                else f"f{next(self._rid_counter)}")
@@ -242,13 +249,16 @@ class EngineRouter:
             stream=TokenStream(rid, tenant),
             meta={"request_id": rid, "tenant": tenant,
                   "priority": priority, "trace": tid})
-        name, warmth = self._pick(tokens)
+        if adapter is not None:
+            req.meta["adapter"] = adapter
+        name, warmth = self._pick(tokens, adapter=adapter)
         rep = self.replicas[name]
+        kw = {} if adapter is None else {"adapter": adapter}
         with self._scoped_registry(name):
             req.inner = rep.engine.submit(
                 tokens, max_new_tokens, tenant=tenant, priority=priority,
                 deadline_s=deadline_s, stop_tokens=stop_tokens,
-                request_id=rid, trace_id=tid)
+                request_id=rid, trace_id=tid, **kw)
         req.replica = name
         req.stream._cancel_cb = lambda: self.cancel(rid)
         self._requests[rid] = req
@@ -708,14 +718,17 @@ class EngineRouter:
                         backoff_s=round(rep.backoff_s, 4))
 
     # -- routing -----------------------------------------------------------
-    def _pick(self, tokens: Sequence[int]):
+    def _pick(self, tokens: Sequence[int],
+              adapter: Optional[str] = None):
         """(replica name, its warmth) for a new admission: warmest
         prefix first, then least load (queue depth, then active count —
         the same numbers ``debug_state()`` serves, read through the
         lightweight ``ServingEngine.load`` accessor), then stable name
         order. A replica whose engine turns up closed is marked dead
         here rather than routed to (its in-flight work fails over on the
-        next pass)."""
+        next pass). ``adapter`` extends warmth with LoRA residency —
+        a replica whose pool holds the named adapter device-resident
+        scores a swap's worth of tokens warmer (read-only probe)."""
         best = None
         for name in sorted(self.replicas):
             rep = self.replicas[name]
@@ -725,9 +738,16 @@ class EngineRouter:
                 self._mark_dead(rep, reason="closed")
                 continue
             try:
-                warmth = int(rep.engine.adapter.prefix_warmth(tokens))
+                if adapter is not None:
+                    warmth = int(rep.engine.adapter.prefix_warmth(
+                        tokens, adapter=adapter))
+                else:
+                    warmth = int(rep.engine.adapter.prefix_warmth(tokens))
             except ServingError:
                 warmth = 0
+            except TypeError:
+                # foreign adapter surface without the adapter= extension
+                warmth = int(rep.engine.adapter.prefix_warmth(tokens))
             load = getattr(rep.engine, "load", None)
             if load is None:           # foreign engine surface
                 ds = rep.engine.debug_state()

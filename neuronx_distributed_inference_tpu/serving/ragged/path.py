@@ -288,10 +288,21 @@ class RaggedDispatchPath:
             [_meta_seed(ad.seqs[r.seq_id].meta if r.seq_id in ad.seqs
                         else chunks[r.seq_id].meta) for r in rows],
             np.int32)
+        # per-row LoRA slots ride the plan (RaggedRow.adapter_id, pinned
+        # at admission): ONE dispatch mixes rows from different adapters;
+        # -1 (base model) clamps to slot 0, the zero adapter. None
+        # without a pool — the kwarg is never passed, so no-pool graphs
+        # stay byte-identical
+        aids = None
+        if ad._lora_pool is not None:
+            aids = np.asarray([max(r.adapter_id, 0) for r in rows],
+                              np.int32)
         if pad_to > b:
             ids, pos, slots, bt, wid, emit, seeds = (
                 _repeat_row0(x, pad_to)
                 for x in (ids, pos, slots, bt, wid, emit, seeds))
+            if aids is not None:
+                aids = _repeat_row0(aids, pad_to)
         ids_dev = jnp.asarray(ids)
         if drafts is not None and spec_W > 1:
             # merge the device-resident drafts into the packed input —
@@ -328,7 +339,7 @@ class RaggedDispatchPath:
             if _FAULTS.active:
                 _FAULTS.fire("ragged_step")
             out = self._dispatch_ragged(ids_dev, pos, slots, bt, wid,
-                                        emit, seeds, rows)
+                                        emit, seeds, rows, aids)
             toks, n_emit = self._fetch_ragged(out, b)
         except ServingError as e:
             self._rollback_plan(plan)
@@ -457,11 +468,14 @@ class RaggedDispatchPath:
 
     # -- dispatch region (nxdi_lint host-sync pass) ------------------------
     def _dispatch_ragged(self, ids_dev, pos, slots, bt, wid, emit, seeds,
-                         rows):
+                         rows, aids=None):
         """Issue THE unified dispatch (one per engine step) without
         materializing any output; the async copies are started so the
         fetch one call later is cheap."""
         ad = self.adapter
+        kw = {"want_hidden": self.wants_hidden, "row_seeds": seeds}
+        if aids is not None:
+            kw["adapter_ids"] = aids
         if ad.app._steady_state:
             # steady-state compile discipline (serving/warmup.py): carry
             # the packed rows' request trace ids so an unexpected
@@ -469,13 +483,10 @@ class RaggedDispatchPath:
             with ad.app.request_context(
                     self._row_trace(r.seq_id) for r in rows):
                 out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid,
-                                         emit,
-                                         want_hidden=self.wants_hidden,
-                                         row_seeds=seeds)
+                                         emit, **kw)
         else:
             out = ad.app._run_ragged(ids_dev, pos, slots, bt, wid, emit,
-                                     want_hidden=self.wants_hidden,
-                                     row_seeds=seeds)
+                                     **kw)
         _async_fetch(out["tokens"])
         _async_fetch(out["num_emitted"])
         ad.host_stats["dispatches"] += 1

@@ -53,6 +53,8 @@ class RaggedRow:
     offset: int                # absolute position of the row's first token
     width: int                 # real tokens in the row (>= 1)
     final: bool = False        # prefill row completing its prompt
+    adapter_id: int = -1       # pinned LoRA slot; -1/0 = base model (the
+    #                            dispatch gathers slot 0, the zero adapter)
 
 
 @dataclass
@@ -110,7 +112,8 @@ class RaggedBatchPlanner:
             widths[s] = w
             rows.append(RaggedRow(
                 s, KIND_VERIFY if max_width > 1 else KIND_DECODE,
-                ad.seqs[s].position, w))
+                ad.seqs[s].position, w,
+                adapter_id=ad._lora_slots.get(s, -1)))
         self._plan_prefill(rows, target)
         return RaggedPlan(rows, widths)
 
@@ -151,5 +154,6 @@ class RaggedBatchPlanner:
             n = int(min(len(st.prompt) - st.done,
                         ad.prefill_chunk_tokens, left))
             rows.append(RaggedRow(s, KIND_PREFILL, st.done, n,
-                                  final=st.done + n == len(st.prompt)))
+                                  final=st.done + n == len(st.prompt),
+                                  adapter_id=ad._lora_slots.get(s, -1)))
             left -= n

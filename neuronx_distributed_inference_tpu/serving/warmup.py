@@ -113,11 +113,18 @@ def _paged_plan(app, widths, bt_widths, chunk_tokens, spec_widths):
     if bt_widths is None:
         bt_widths = list(app._bt_buckets)
     chunk = max(cfg.decode_chunk_tokens, 1)
+    # a LoRA-built model traces a SECOND graph per shape once any row
+    # carries an adapter slot (the adapter_ids kwarg changes the jit
+    # signature) — warm both so the first multi-LoRA batch after
+    # declare_steady_state() is a cache hit, not a sentinel trip.
+    # slot 0 is the pinned zero adapter, so the dummy call writes nothing.
+    lora_kw = ({"adapter_ids": np.zeros((b,), np.int32)}
+               if app.spec.lora is not None else None)
     plan: List[tuple] = []
     for tw in bt_widths:
         bt = np.zeros((b, tw), np.int32)        # null block only: no writes
 
-        def ragged_thunk(w, bt=bt):
+        def ragged_thunk(w, bt=bt, **kw):
             # dummy no-write ragged dispatch: every slot negative, widths
             # ones, nothing emitted (mirrors PagedCausalLMApplication.
             # warmup's dummy-call discipline)
@@ -125,19 +132,35 @@ def _paged_plan(app, widths, bt_widths, chunk_tokens, spec_widths):
                             np.zeros((b, w), np.int32),
                             np.full((b, w), -1, np.int32), bt,
                             np.ones((b,), np.int32),
-                            np.zeros((b,), np.int32))
+                            np.zeros((b,), np.int32), **kw)
 
         for w in sorted(widths):
             plan.append(("ragged", w, lambda w=w, bt=bt: ragged_thunk(w, bt)))
+            if lora_kw is not None:
+                plan.append(("ragged_lora", w,
+                             lambda w=w, bt=bt: ragged_thunk(w, bt, **lora_kw)))
         if chunk > 1:
             plan.append(("paged_loop", chunk, lambda bt=bt: app._run_paged_loop(
                 np.zeros((b,), np.int32), np.zeros((b,), np.int32), bt,
                 chunk)))
+            if lora_kw is not None:
+                plan.append(("paged_loop_lora", chunk,
+                             lambda bt=bt: app._run_paged_loop(
+                                 np.zeros((b,), np.int32),
+                                 np.zeros((b,), np.int32), bt, chunk,
+                                 **lora_kw)))
         for w in sorted(spec_widths or ()):
             plan.append(("spec_verify", w, lambda w=w, bt=bt: app._run_spec_verify(
                 np.zeros((b, w), np.int32), np.zeros((b, w), np.int32),
                 np.full((b, w), -1, np.int32), bt,
                 np.ones((b,), np.int32))))
+            if lora_kw is not None:
+                plan.append(("spec_verify_lora", w,
+                             lambda w=w, bt=bt: app._run_spec_verify(
+                                 np.zeros((b, w), np.int32),
+                                 np.zeros((b, w), np.int32),
+                                 np.full((b, w), -1, np.int32), bt,
+                                 np.ones((b,), np.int32), **lora_kw)))
     return plan
 
 

@@ -105,13 +105,18 @@ KV_SPILL_BYTES = "nxdi_kv_spill_bytes"
 KV_RESTORE_BLOCKS_TOTAL = "nxdi_kv_restore_blocks_total"
 KV_RESTORE_TOKENS_TOTAL = "nxdi_kv_restore_tokens_total"
 
+# -- multi-LoRA adapter pool (serving/lora_pool.py) --------------------------
+LORA_RESIDENCY_HITS_TOTAL = "nxdi_lora_residency_hits_total"
+LORA_SWAPS_TOTAL = "nxdi_lora_swaps_total"           # adapter
+LORA_SWAP_BYTES = "nxdi_lora_swap_bytes"
+
 # -- per-tenant SLO plane (telemetry/slo.py) ---------------------------------
 # signal: ttft|tpot|queue_wait ; window: short|long (policy window lengths)
 SLO_ATTAINMENT = "nxdi_slo_attainment"               # tenant, signal, window
 SLO_BURN_RATE = "nxdi_slo_burn_rate"                 # tenant, signal, window
 
 # -- degradation controller (resilience/controller.py) -----------------------
-# action: shed_speculation|tighten_admission|drop_ragged
+# action: shed_speculation|tighten_admission|drop_ragged|shed_adapters
 DEGRADED = "nxdi_degraded"                           # tenant, action
 
 # -- degradations -----------------------------------------------------------
@@ -531,6 +536,30 @@ def kv_restore_tokens_counter(reg):
         "spill-tier restore")
 
 
+def lora_residency_hits_counter(reg):
+    return reg.counter(
+        LORA_RESIDENCY_HITS_TOTAL,
+        "Adapter acquisitions served by an already device-resident slot "
+        "(no swap H2D traffic) — hits / (hits + swaps) is the pool's "
+        "residency hit-rate")
+
+
+def lora_swaps_counter(reg):
+    return reg.counter(
+        LORA_SWAPS_TOTAL,
+        "Adapter swaps written into a stacked device slot (H2D), by "
+        "adapter name — each swap pays the (A,B) factor upload the "
+        "residency pool exists to amortize",
+        labels=("adapter",))
+
+
+def lora_swap_bytes_counter(reg):
+    return reg.counter(
+        LORA_SWAP_BYTES,
+        "Bytes of stacked (A,B) LoRA factors uploaded to device slots by "
+        "adapter swaps (cumulative H2D swap traffic)")
+
+
 def slo_attainment_gauge(reg):
     return reg.gauge(
         SLO_ATTAINMENT,
@@ -555,7 +584,8 @@ def degraded_gauge(reg):
         "1 while the degradation controller holds the action active for "
         "the tenant (hysteresis-guarded; set on degrade.enter, cleared "
         "on degrade.exit), 0 after exit "
-        "(action=shed_speculation|tighten_admission|drop_ragged)",
+        "(action=shed_speculation|tighten_admission|drop_ragged|"
+        "shed_adapters)",
         labels=("tenant", "action"))
 
 

@@ -523,17 +523,28 @@ def _graph_entries(app) -> List[Tuple[str, str, Callable[[], Tuple]]]:
                       np.ones((b,), np.int32)), {})))
         # the ragged UNIFIED dispatch (serving/ragged/): one mixed
         # prefill+decode+verify graph at the same representative width
+        def ragged_args():
+            return (app.params, app.cache, np.zeros((b, sw), np.int32),
+                    np.zeros((b, sw), np.int32),
+                    np.full((b, sw), -1, np.int32),
+                    np.zeros((b, width_bt), np.int32),
+                    np.ones((b,), np.int32),
+                    np.zeros((b,), np.int32),
+                    app._default_sampling_params(b),
+                    rng)
+
         entries.append((
             "ragged", f"W{sw}xb{b}",
-            lambda: (app._jit_ragged(False),
-                     (app.params, app.cache, np.zeros((b, sw), np.int32),
-                      np.zeros((b, sw), np.int32),
-                      np.full((b, sw), -1, np.int32),
-                      np.zeros((b, width_bt), np.int32),
-                      np.ones((b,), np.int32),
-                      np.zeros((b,), np.int32),
-                      app._default_sampling_params(b),
-                      rng), {})))
+            lambda: (app._jit_ragged(False), ragged_args(), {})))
+        if app.spec.lora is not None:
+            # the multi-LoRA variant: same ragged graph plus the per-row
+            # adapter gather (serving/lora_pool.py) — reported separately
+            # so the bytes/flops delta of the gathered (A,B) einsum is
+            # visible in the graph report
+            entries.append((
+                "ragged_lora", f"W{sw}xb{b}",
+                lambda: (app._jit_ragged(False), ragged_args(),
+                         {"adapter_ids": np.zeros((b,), np.int32)})))
         return entries
 
     cb = cfg.ctx_batch_size

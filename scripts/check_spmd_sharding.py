@@ -40,6 +40,11 @@ plumbing; all CPU-mesh compiles, no execution):
   * ``paged_ragged_dp2tp2`` — the ragged UNIFIED mixed
     prefill+decode+verify dispatch (serving/ragged/,
     ``model_base.paged_ragged_step``) at the same W=4
+  * ``paged_ragged_lora_dp2tp2`` — the same unified dispatch on a
+    LoRA-built app with per-row ``adapter_ids`` (multi-LoRA serving,
+    serving/lora_pool.py): the stacked (A, B) gather + delta einsum
+    must partition cleanly (lora_A replicated, lora_B sharded with its
+    base projection) and add NO collective over the plain ragged graph
   * ``cb_decode_int8_dp2tp2`` / ``paged_decode_fp8_dp2tp2`` — the same
     decode steps with ``CollectiveConfig`` quantized collectives (int8 /
     fp8 wire payloads): the row-parallel output all-reduces lower to
@@ -207,11 +212,13 @@ def _entry_graph(moe: bool):
 _APP_CACHE: Dict[Tuple[bool, Optional[str]], Any] = {}
 
 
-def _serving_app(paged: bool, collective_dtype: Optional[str] = None):
-    key = (paged, collective_dtype)
+def _serving_app(paged: bool, collective_dtype: Optional[str] = None,
+                 lora: bool = False):
+    key = (paged, collective_dtype, lora)
     if key in _APP_CACHE:         # each app serves several pinned graphs
         return _APP_CACHE[key]    # — one weights+cache init per config
     from neuronx_distributed_inference_tpu.config import (CollectiveConfig,
+                                                          LoraServingConfig,
                                                           TpuConfig)
     from neuronx_distributed_inference_tpu.models.application import (
         CausalLMApplication, PagedCausalLMApplication)
@@ -224,6 +231,13 @@ def _serving_app(paged: bool, collective_dtype: Optional[str] = None):
              if paged else {"is_continuous_batching": True})
     if collective_dtype is not None:
         extra["collective_config"] = CollectiveConfig(dtype=collective_dtype)
+    if lora:
+        # a SEPARATE app (not the plain paged one): the stacked adapter
+        # arrays ride the params pytree, so grafting them onto the
+        # shared app would shift every existing pinned graph's signature
+        extra["lora_config"] = LoraServingConfig(
+            max_loras=3, max_lora_rank=4,
+            target_modules=["q_proj", "v_proj"])
     tcfg = TpuConfig(batch_size=2, seq_len=128, dtype="float32",
                      enable_bucketing=True, context_encoding_buckets=[16],
                      decode_chunk_tokens=4, tp_degree=4,
@@ -237,9 +251,10 @@ def _serving_app(paged: bool, collective_dtype: Optional[str] = None):
 
 
 def _app_graph(paged: bool, kind: str,
-               collective_dtype: Optional[str] = None):
+               collective_dtype: Optional[str] = None,
+               lora: bool = False):
     from neuronx_distributed_inference_tpu.telemetry import observatory
-    app = _serving_app(paged, collective_dtype)
+    app = _serving_app(paged, collective_dtype, lora)
     for k, bucket, build in observatory._graph_entries(app):
         if k == kind:
             fn, args, kwargs = build()
@@ -256,6 +271,12 @@ PINNED: Dict[str, Any] = {
     "cb_decode_dp2tp2": lambda: _app_graph(False, "decode"),
     "paged_spec_verify_dp2tp2": lambda: _app_graph(True, "spec_verify"),
     "paged_ragged_dp2tp2": lambda: _app_graph(True, "ragged"),
+    # the multi-LoRA ragged dispatch: per-row gathered (A, B) factors
+    # (lora_A replicated, lora_B row-sharded over tp) riding the SAME
+    # unified graph — pins that the adapter gather adds no collective
+    # beyond the existing row-parallel reduces
+    "paged_ragged_lora_dp2tp2": lambda: _app_graph(True, "ragged_lora",
+                                                   lora=True),
     # quantized-collective decode graphs (EQuARX-style s8/f8 ppermute
     # rings replacing the row-parallel fp32 all-reduces) — the dtype leg
     # of the census keys pins the wire-byte reduction

@@ -1,0 +1,413 @@
+"""Multi-LoRA serving (ISSUE 20): the bounded adapter pool
+(serving/lora_pool.py) and per-row gathered adapters on the unified
+ragged dispatch.
+
+Pins the core claims: a mixed-adapter ragged batch (rows from DIFFERENT
+adapters plus base-model rows in ONE engine) emits streams bit-identical
+to per-adapter single runs — greedy AND coupled-sampled — at exactly one
+materialized dispatch per engine step; base-model rows match a no-LoRA
+build exactly; speculative verify rows run under a non-base adapter; the
+pool's LRU/pin/spill/transactional-swap semantics hold under injected
+faults; and the router-facing surfaces (prefix_warmth adapter affinity,
+the shed_adapters actuator) behave as documented in README
+"Multi-LoRA serving".
+"""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu import telemetry
+from neuronx_distributed_inference_tpu.config import (LoraServingConfig,
+                                                      OnDeviceSamplingConfig,
+                                                      TpuConfig)
+from neuronx_distributed_inference_tpu.models.application import \
+    PagedCausalLMApplication
+from neuronx_distributed_inference_tpu.models.llama import (
+    LlamaFamily, LlamaInferenceConfig)
+from neuronx_distributed_inference_tpu.resilience import (FAULTS,
+                                                          CapacityError,
+                                                          ConfigurationError,
+                                                          StepFailure)
+from neuronx_distributed_inference_tpu.resilience.controller import (
+    DEGRADE_ACTIONS, DegradationController)
+from neuronx_distributed_inference_tpu.serving import (LoraAdapterPool,
+                                                       PagedEngineAdapter)
+from neuronx_distributed_inference_tpu.telemetry import metrics as tmetrics
+
+HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
+          num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+          head_dim=16, vocab_size=512, rms_norm_eps=1e-5, rope_theta=10000.0,
+          hidden_act="silu", tie_word_embeddings=False,
+          torch_dtype="float32")
+
+RNG = np.random.default_rng(41)
+P_A = RNG.integers(1, 500, size=9).tolist()
+P_B = RNG.integers(1, 500, size=12).tolist()
+P_C = RNG.integers(1, 500, size=7).tolist()
+WANT = 6
+
+
+def _make_app(lora=True, sampling=None):
+    tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
+                     enable_bucketing=True, context_encoding_buckets=[16],
+                     is_block_kv_layout=True, pa_block_size=8,
+                     pa_num_blocks=40, is_prefix_caching=True,
+                     on_device_sampling_config=sampling,
+                     lora_config=(LoraServingConfig(
+                         max_loras=3, max_lora_rank=4,
+                         target_modules=["q_proj", "v_proj"])
+                         if lora else None))
+    a = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
+                                 LlamaFamily)
+    a.init_random_weights(7).init_cache()
+    return a
+
+
+@pytest.fixture(scope="module")
+def app():
+    return _make_app()
+
+
+@pytest.fixture(scope="module")
+def base_app():
+    """Same weights seed, NO lora_config — the off-knob reference."""
+    return _make_app(lora=False)
+
+
+def _adapter_arrays(app, seed):
+    """Deterministic synthetic adapter in the register_arrays layout
+    ({module: (A (L, in, r), B (L, r, out))})."""
+    lw = app.params["layers"]
+    rng = np.random.default_rng(seed)
+    arrays = {}
+    for mod in app.spec.lora.target_modules:
+        sa = lw[f"lora_A_{mod}"].shape          # (L, slots, in, r)
+        sb = lw[f"lora_B_{mod}"].shape          # (L, slots, r, out)
+        arrays[mod] = (
+            (rng.standard_normal((sa[0], sa[2], sa[3]))
+             * 0.3).astype(np.float32),
+            (rng.standard_normal((sb[0], sb[2], sb[3]))
+             * 0.3).astype(np.float32))
+    return arrays
+
+
+def _pool(app, n=3, **kw):
+    pool = LoraAdapterPool(app, **kw)
+    for i in range(n):
+        pool.register_arrays(f"l{i}", _adapter_arrays(app, 100 + i))
+    return pool
+
+
+def _collect(eng, sids, want=WANT, cap=80):
+    got = {s: [] for s in sids}
+    steps = 0
+    while any(len(got[s]) < want for s in sids):
+        for s, toks in eng.step().items():
+            got[s].extend(toks if isinstance(toks, list) else [toks])
+        steps += 1
+        assert steps < cap, "no progress"
+    return {s: v[:want] for s, v in got.items()}, steps
+
+
+# ---------------------------------------------------------------------------
+# mixed-adapter bit-identity at one dispatch per step
+# ---------------------------------------------------------------------------
+
+def test_mixed_adapters_bit_identical_one_dispatch(app):
+    """Three streams under three DIFFERENT adapters (l0, l1, base) in
+    one ragged engine: exactly one materialized dispatch per engine
+    step, and every stream bit-identical to its per-adapter single
+    run."""
+    pool = _pool(app)
+    eng = PagedEngineAdapter(app, ragged=True, lora_pool=pool)
+    base = dict(eng.host_stats)
+    eng.add_requests([0, 1, 2], [P_A, P_B, P_C],
+                     meta=[{"adapter": "l0"}, {"adapter": "l1"}, None])
+    assert eng.host_stats["lora_rows"] - base["lora_rows"] == 2
+    mixed, steps = _collect(eng, (0, 1, 2))
+    stats = {k: eng.host_stats[k] - base.get(k, 0) for k in eng.host_stats}
+    assert stats["dispatches"] + stats["prefill_dispatches"] == steps
+    eng.release([0, 1, 2])
+    # different adapters genuinely diverge on the same-weight base model
+    assert mixed[0] != mixed[1]
+    # per-adapter single runs (fresh pools, same app — prefix caching
+    # must not perturb tokens either)
+    for name, prompt, want_toks in (("l0", P_A, mixed[0]),
+                                    ("l1", P_B, mixed[1]),
+                                    (None, P_C, mixed[2])):
+        ref_pool = _pool(app)
+        ref = PagedEngineAdapter(app, ragged=True, lora_pool=ref_pool)
+        meta = [{"adapter": name}] if name else None
+        ref.add_requests([5], [prompt], meta=meta)
+        single, _ = _collect(ref, (5,))
+        ref.release([5])
+        assert single[5] == want_toks, name
+
+
+def test_base_rows_match_no_lora_build(app, base_app):
+    """adapter-less rows on a LoRA-built app are bit-identical to an app
+    built WITHOUT lora_config (slot-0 zero-adapter gather adds exactly
+    nothing), and the off-knob guards hold."""
+    pool = _pool(app)
+    eng = PagedEngineAdapter(app, ragged=True, lora_pool=pool)
+    eng.add_requests([0], [P_C])
+    lora_toks, _ = _collect(eng, (0,))
+    eng.release([0])
+    ref = PagedEngineAdapter(base_app, ragged=True)
+    ref.add_requests([0], [P_C])
+    base_toks, _ = _collect(ref, (0,))
+    ref.release([0])
+    assert lora_toks[0] == base_toks[0]
+    # off-knob guards: no adapter_ids ever passed without a pool, and a
+    # no-LoRA build refuses them loudly
+    assert app._lora_adapter_ids(None) is None
+    with pytest.raises(ValueError, match="without"):
+        base_app._lora_adapter_ids(np.zeros((4,), np.int32))
+    with pytest.raises(ConfigurationError, match="lora_config"):
+        LoraAdapterPool(base_app)
+
+
+def test_sampled_mixed_adapters_bit_identical():
+    """Coupled-sampled streams (PR-19 semantics: seeded, keyed by
+    absolute position) under mixed adapters match their single-adapter
+    runs token-for-token too."""
+    sc = OnDeviceSamplingConfig(do_sample=True, top_k=8, top_p=0.95,
+                                temperature=1.3, stream_seed=11)
+    sapp = _make_app(sampling=sc)
+    pool = _pool(sapp, n=2)
+    eng = PagedEngineAdapter(sapp, ragged=True, lora_pool=pool)
+    eng.add_requests([0, 1], [P_A, P_B],
+                     meta=[{"adapter": "l0", "sampling_seed": 5},
+                           {"adapter": "l1", "sampling_seed": 9}])
+    mixed, _ = _collect(eng, (0, 1), want=4)
+    eng.release([0, 1])
+    for name, seed, prompt, want_toks in (("l0", 5, P_A, mixed[0]),
+                                          ("l1", 9, P_B, mixed[1])):
+        ref = PagedEngineAdapter(sapp, ragged=True, lora_pool=_pool(sapp, 2))
+        ref.add_requests([5], [prompt],
+                         meta=[{"adapter": name, "sampling_seed": seed}])
+        single, _ = _collect(ref, (5,), want=4)
+        ref.release([5])
+        assert single[5] == want_toks, name
+
+
+def test_spec_verify_rows_under_adapter(app):
+    """Speculative draft/verify windows run under a non-base adapter:
+    the self-draft ragged path with a pool produces the same greedy
+    stream as the plain ragged path under the same adapter."""
+    pool = _pool(app)
+    eng = PagedEngineAdapter(app, ragged=True, speculation=2,
+                             lora_pool=pool)
+    eng.add_requests([0], [P_A], meta=[{"adapter": "l2"}])
+    spec_toks, _ = _collect(eng, (0,))
+    eng.release([0])
+    ref = PagedEngineAdapter(app, ragged=True, lora_pool=_pool(app))
+    ref.add_requests([3], [P_A], meta=[{"adapter": "l2"}])
+    plain_toks, _ = _collect(ref, (3,))
+    ref.release([3])
+    assert spec_toks[0] == plain_toks[3]
+
+
+# ---------------------------------------------------------------------------
+# pool semantics
+# ---------------------------------------------------------------------------
+
+def test_pool_lru_pins_capacity_and_restore(app):
+    pool = _pool(app)
+    assert pool.n_slots == 2
+    s0 = pool.acquire("l0")
+    s1 = pool.acquire("l1")
+    assert {s0, s1} == {1, 2} and pool.resident("l0")
+    # every slot pinned by a live acquisition: typed capacity refusal
+    with pytest.raises(CapacityError, match="pinned"):
+        pool.acquire("l2")
+    pool.release("l0")
+    # the unpinned LRU victim (l0) is evicted and spilled host-side
+    s2 = pool.acquire("l2")
+    assert s2 == s0 and not pool.resident("l0")
+    assert pool.stats["evictions"] == 1 and pool.stats["spills"] == 1
+    # re-acquire restores from the host cache, not the checkpoint
+    pool.release("l1")
+    pool.acquire("l0")
+    assert pool.stats["restores"] == 1
+    # a hit touches recency and bumps the pin count
+    assert pool.acquire("l0") == pool.slot_of("l0")
+    assert pool.pins("l0") == 2 and pool.stats["hits"] == 1
+    pool.release("zzz")                        # non-resident: no-op
+    with pytest.raises(ConfigurationError, match="unknown adapter"):
+        pool.acquire("never-registered")
+    with pytest.raises(ConfigurationError):
+        LoraAdapterPool(app, host_cache_adapters=0)
+
+
+def test_swap_rollback_and_spill_best_effort(app):
+    """adapter_swap: the device write is transactional — an injected
+    trip rolls the stacked factors back, frees the claimed slot, and
+    surfaces as a retry-safe StepFailure; plain retry heals.
+    adapter_spill: a trip is swallowed and counted, the eviction
+    proceeds, and the later re-acquire cold-loads."""
+    pool = _pool(app)
+    with FAULTS.inject("adapter_swap", nth=1, times=1):
+        with pytest.raises(StepFailure) as ei:
+            pool.acquire("l0")
+    assert ei.value.retry_safe and ei.value.phase == "adapter_swap"
+    assert not pool.resident("l0") and pool.stats["swap_errors"] == 1
+    assert sorted(pool.debug_state()["free_slots"]) == [1, 2]
+    assert pool.acquire("l0") in (1, 2)        # retry heals
+    pool.release("l0")
+    pool.acquire("l1")
+    pool.release("l1")
+    with FAULTS.inject("adapter_spill", nth=1, times=1):
+        pool.acquire("l2")                     # evicts l0, spill trips
+    assert pool.stats["spill_errors"] == 1
+    assert "l0" not in pool.debug_state()["host_cached"]
+    cold = pool.stats["cold_loads"]
+    pool.release("l2")
+    pool.acquire("l0")                         # not host-cached: cold load
+    assert pool.stats["cold_loads"] == cold + 1
+
+
+def test_pool_metrics_and_trace(app):
+    reg = telemetry.MetricsRegistry()
+    pool = _pool(app, telemetry=reg)
+    pool.acquire("l0")
+    pool.acquire("l0")
+    assert tmetrics.lora_swaps_counter(reg).get(adapter="l0") == 1.0
+    assert tmetrics.lora_residency_hits_counter(reg).get() == 1.0
+    assert tmetrics.lora_swap_bytes_counter(reg).get() == \
+        pool.stats["swap_bytes"] > 0
+    pool.release("l0")
+    pool.release("l0")
+
+
+def test_pool_requires_lora_build():
+    class _Spec:
+        lora = None
+
+    class _Fake:
+        spec = _Spec()
+
+    with pytest.raises(ConfigurationError, match="lora_config"):
+        LoraAdapterPool(_Fake())
+
+
+# ---------------------------------------------------------------------------
+# router affinity + degradation actuator
+# ---------------------------------------------------------------------------
+
+def test_prefix_warmth_adapter_affinity(app):
+    pool = _pool(app)
+    ad = PagedEngineAdapter(app, ragged=True, lora_pool=pool)
+    cold = ad.prefix_warmth(P_A, adapter="l0")
+    assert cold == ad.prefix_warmth(P_A)       # not resident: no bonus
+    pool.acquire("l0")
+    lru_before = list(pool._lru)
+    warm = ad.prefix_warmth(P_A, adapter="l0")
+    assert warm == cold + ad.prefill_chunk_tokens
+    assert list(pool._lru) == lru_before       # read-only probe
+    pool.release("l0")
+
+
+def test_shed_adapters_admits_base_model(app):
+    """set_adapter_shed(True): a LoRA-tagged admission takes no pool
+    acquire, is annotated lora_shed=True, and streams the BASE model
+    (bit-identical to an adapter-less request)."""
+    pool = _pool(app)
+    eng = PagedEngineAdapter(app, ragged=True, lora_pool=pool)
+    eng.add_requests([0], [P_B])
+    base_toks, _ = _collect(eng, (0,))
+    eng.release([0])
+    eng.set_adapter_shed(True)
+    assert eng.adapter_shed
+    meta = {"adapter": "l0"}
+    eng.add_requests([1], [P_B], meta=[meta])
+    shed_toks, _ = _collect(eng, (1,))
+    eng.release([1])
+    assert shed_toks[1] == base_toks[0]
+    assert meta["lora_shed"] is True
+    assert pool.stats["misses"] == 0 and pool.stats["swaps"] == 0
+    assert eng.host_stats["lora_shed_requests"] == 1
+    eng.set_adapter_shed(False)
+    assert not eng.adapter_shed
+
+
+def test_controller_reconciles_shed_adapters():
+    assert "shed_adapters" in DEGRADE_ACTIONS
+
+    class _FakeAdapter:
+        adapter_shed = False
+
+        def set_speculation_shed(self, shed):
+            pass
+
+        def set_adapter_shed(self, shed):
+            self.adapter_shed = bool(shed)
+
+    class _FakeQueue:
+        def set_weight_scale(self, tenant, scale):
+            pass
+
+    class _FakeEngine:
+        adapter = _FakeAdapter()
+        queue = _FakeQueue()
+        slo = None
+
+    ctl = DegradationController(enter_burn=2.0, exit_burn=1.0,
+                                shed_adapters=True)
+    eng = _FakeEngine()
+    ctl._active[("shed_adapters", "tA")] = 0.0
+    ctl._apply(eng)
+    assert eng.adapter.adapter_shed
+    del ctl._active[("shed_adapters", "tA")]
+    ctl._apply(eng)
+    assert not eng.adapter.adapter_shed
+
+
+def test_checkpoint_load_backfills_lora_leaves(app, base_app):
+    """The load_weights path: a checkpoint state dict carries BASE
+    weights only, so ``_put_params`` must stack zeroed
+    ``(L, max_loras, ...)`` adapter leaves for a LoRA build (slot 0 =
+    the pinned zero adapter) instead of failing the sharding tree-map —
+    and leave already-present leaves (random-init, quantized
+    round-trips) alone."""
+    import jax
+
+    from neuronx_distributed_inference_tpu.models import model_base
+
+    host = jax.device_get(base_app.params)     # fused, no lora leaves
+    fresh = PagedCausalLMApplication(
+        None, LlamaInferenceConfig(app.tpu_config, **HF), LlamaFamily)
+    fresh._put_params(host)
+    for mod in ("q_proj", "v_proj"):
+        a = np.asarray(fresh.params["layers"][f"lora_A_{mod}"])
+        b = np.asarray(fresh.params["layers"][f"lora_B_{mod}"])
+        assert a.shape[:2] == b.shape[:2] == (HF["num_hidden_layers"], 3)
+        assert not a.any() and not b.any()
+    # no-op cases: leaves already stacked / no lora_config
+    before = app.params["layers"]["lora_A_q_proj"]
+    assert model_base.stack_lora_host(
+        app.spec, app.params)["layers"]["lora_A_q_proj"] is before
+    plain = {"layers": {"qkv_proj": np.zeros((2, 3))}}
+    assert model_base.stack_lora_host(base_app.spec, plain) is plain
+    assert set(plain["layers"]) == {"qkv_proj"}
+
+
+def test_lint_covers_lora_pool(tmp_path):
+    """serving/lora_pool.py rides the error-paths + host-sync lints with
+    zero findings, and the new fault points are registered."""
+    import json
+
+    from conftest import load_nxdi_lint
+    from neuronx_distributed_inference_tpu.resilience.faults import \
+        FAULT_POINTS
+    assert "adapter_swap" in FAULT_POINTS
+    assert "adapter_spill" in FAULT_POINTS
+    nxdi_lint = load_nxdi_lint()
+    out = tmp_path / "lint.json"
+    assert nxdi_lint.main(
+        ["--passes", "error-paths,host-sync,metric-names,fault-points",
+         "--json", str(out)]) == 0
+    data = json.loads(out.read_text())
+    assert data["findings"] == [] and data["suppressed"] == []
+    assert ("neuronx_distributed_inference_tpu/serving/lora_pool.py"
+            in set(data["files"]))

@@ -19,7 +19,8 @@ from types import SimpleNamespace
 import numpy as np
 import pytest
 
-from neuronx_distributed_inference_tpu.config import TpuConfig
+from neuronx_distributed_inference_tpu.config import (LoraServingConfig,
+                                                      TpuConfig)
 from neuronx_distributed_inference_tpu.models.application import (
     CausalLMApplication, PagedCausalLMApplication)
 from neuronx_distributed_inference_tpu.models.llama import (
@@ -48,11 +49,17 @@ HF = dict(model_type="llama", hidden_size=64, intermediate_size=128,
 
 def _make_paged_app():
     """Same shapes as test_fleet / test_serving_engine (warm graphs);
-    seed 7 so every replica and the golden share one set of weights."""
+    seed 7 so every replica and the golden share one set of weights.
+    LoRA-built (slots start zero, so base streams stay bit-identical
+    with the no-LoRA golden): the chaos workload's adapter-churn phase
+    needs the stacked arrays to traverse adapter_swap/adapter_spill."""
     tcfg = TpuConfig(batch_size=4, seq_len=64, dtype="float32",
                      enable_bucketing=True, context_encoding_buckets=[16],
                      is_block_kv_layout=True, pa_block_size=8,
-                     is_prefix_caching=True)
+                     is_prefix_caching=True,
+                     lora_config=LoraServingConfig(
+                         max_loras=3, max_lora_rank=4,
+                         target_modules=["q_proj", "v_proj"]))
     app = PagedCausalLMApplication(None, LlamaInferenceConfig(tcfg, **HF),
                                    LlamaFamily)
     app.init_random_weights(7).init_cache()
@@ -580,6 +587,8 @@ def test_fault_points_lint_green_and_rename_red(tmp_path):
             _FAULTS.fire("migrate_capture")
             _FAULTS.fire("migrate_admit")
             _FAULTS.fire("autoscale")
+            _FAULTS.fire("adapter_swap")
+            _FAULTS.fire("adapter_spill")
         """))
     ctx = analysis.LintContext(tmp_path)
     findings = fp_pass.run(ctx, paths=[str(doctored), str(fire_all)])
@@ -632,7 +641,7 @@ def test_chaos_smoke_seeded_subset(apps):
     cells = campaign.sample_cells(3)
     report = campaign.run(cells)
     assert report["schema"] == "nxdi-chaos-v1"
-    assert report["golden"]["streams"] == 7     # handoff + 6 engine streams
+    assert report["golden"]["streams"] == 8     # handoff + 6 engine + lora
     assert report["golden"]["bad"] == []
     for row in report["cells"]:
         assert row["ok"], row
